@@ -1,0 +1,1 @@
+"""Columnar device layer: HBM-resident Arrow-style tables + plan executor."""

@@ -1,0 +1,7 @@
+"""Device plan executor (placeholder until M2 lands this round)."""
+
+
+def try_execute_plan(plan):
+    # No device tables exist yet, so no plan can be device-executable;
+    # sinks fall back to the host path on None.
+    return None

@@ -1,0 +1,15 @@
+"""CSV -> DeviceTable ingestion (placeholder until M2 lands this round)."""
+
+
+def reader_to_device(reader, device="tpu", **opts):
+    raise NotImplementedError(
+        "OnDevice(): the columnar device executor is not built yet in this "
+        "checkout; use the host path (Take(reader)) meanwhile"
+    )
+
+
+def index_to_device(index, device="tpu"):
+    raise NotImplementedError(
+        "Index.on_device(): the columnar device executor is not built yet "
+        "in this checkout"
+    )

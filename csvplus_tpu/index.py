@@ -1,0 +1,291 @@
+"""Index: a sorted, materialized collection of Rows with O(log n) search.
+
+Reference: csvplus.go:610-920.  Rows are sorted lexicographically by the
+key columns (byte order — Python's str comparison equals Go's
+``strings.Compare`` on UTF-8 because UTF-8 byte order preserves code-point
+order), searched by binary search, and optionally persisted.
+
+Semantics preserved:
+
+* building an index fully materializes the source (csvplus.go:722-733) and
+  validates every row has all key columns, with the reference's exact
+  error message;
+* ``find``/``sub_index`` accept a *prefix* of the key values and return
+  zero-copy row ranges (csvplus.go:869-891);
+* joins never mutate the index (pinned by csvplus_test.go:325-365);
+* ``resolve_duplicates`` calls the user back once per duplicate group; the
+  returned row replaces the group when it has at least as many cells as
+  there are key columns, an empty row drops the group (csvplus.go:643-653,
+  809-867).
+
+**Known divergence from the reference (intentional):** the reference's
+in-place compaction drops the final row of the index whenever the last
+row is a *singleton* following a duplicate group (``dedup``
+csvplus.go:842,851-859 never flushes the trailing pending row; its own
+tests never check the index contents afterwards, so the data loss is
+invisible upstream).  This implementation keeps that row.
+
+The optional ``device_table`` attribute carries an HBM-resident columnar
+copy of the index (built by ``on_device()``), used by the device join/
+search kernels in M3+.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from .errors import CsvPlusError
+from .row import Row, all_columns_unique, equal_rows
+from .source import DataSource, RowFunc, iterate, take_rows
+
+_MAGIC = "csvplus-tpu-index"
+_VERSION = 1
+
+
+class IndexImpl:
+    """Sorted rows + key column list (reference ``indexImpl``
+    csvplus.go:785-788)."""
+
+    __slots__ = ("rows", "columns", "_keys")
+
+    def __init__(self, rows: List[Row], columns: Sequence[str]):
+        self.rows = rows
+        self.columns = list(columns)
+        self._keys: Optional[List[Tuple[str, ...]]] = None
+
+    # -- key cache ---------------------------------------------------------
+
+    @property
+    def keys(self) -> List[Tuple[str, ...]]:
+        """Per-row key tuples, built lazily and invalidated on mutation."""
+        if self._keys is None:
+            cols = self.columns
+            self._keys = [tuple(r[c] for c in cols) for r in self.rows]
+        return self._keys
+
+    def _invalidate(self) -> None:
+        self._keys = None
+
+    def sort(self) -> None:
+        """Sort rows by the key columns (csvplus.go:794-807).  Stable —
+        a deterministic refinement of the reference's unstable sort."""
+        cols = self.columns
+        self.rows.sort(key=lambda r: tuple(r[c] for c in cols))
+        self._invalidate()
+
+    # -- binary search (csvplus.go:869-920) --------------------------------
+
+    def bounds(self, values: Sequence[str]) -> Tuple[int, int]:
+        """[lower, upper) range of rows whose key prefix equals *values*."""
+        if not values:
+            return 0, len(self.rows)
+        if len(values) > len(self.columns):
+            raise ValueError("too many columns in Index.find()")
+        k = len(values)
+        v = tuple(values)
+        keys = self.keys
+        lower = bisect.bisect_left(keys, v, key=lambda kt: kt[:k])
+        upper = bisect.bisect_right(keys, v, lo=lower, key=lambda kt: kt[:k])
+        return lower, upper
+
+    def find_rows(self, values: Sequence[str]) -> List[Row]:
+        """Zero-copy row range matching the key prefix (csvplus.go:870-891)."""
+        lower, upper = self.bounds(values)
+        return self.rows[lower:upper]
+
+    def has(self, values: Sequence[str]) -> bool:
+        """True when any row matches the key prefix (csvplus.go:899-905)."""
+        lower, upper = self.bounds(values)
+        return lower < upper
+
+    # -- deduplication (csvplus.go:809-867) --------------------------------
+
+    def dedup(self, resolve: Callable[[List[Row]], Optional[Row]]) -> None:
+        rows, cols = self.rows, self.columns
+        out: List[Row] = []
+        i, n = 0, len(rows)
+        changed = False
+        while i < n:
+            j = i + 1
+            while j < n and equal_rows(cols, rows[i], rows[j]):
+                j += 1
+            if j - i == 1:
+                out.append(rows[i])
+            else:
+                changed = True
+                chosen = resolve(rows[i:j])
+                # keep the chosen row unless it is 'empty' — the reference's
+                # emptiness test is len(row) >= len(key columns)
+                # (csvplus.go:845-848)
+                if chosen is not None and len(chosen) >= len(cols):
+                    out.append(chosen if isinstance(chosen, Row) else Row(chosen))
+            i = j
+        if changed:
+            self.rows = out
+            self._invalidate()
+
+
+class Index:
+    """Sorted collection of Rows; see module docstring.
+
+    Reference: ``Index`` csvplus.go:610-653.
+    """
+
+    def __init__(self, impl: IndexImpl):
+        self._impl = impl
+        self.device_table = None  # set by on_device(); used by device kernels
+
+    # -- iteration ---------------------------------------------------------
+
+    def iterate(self, fn: RowFunc) -> None:
+        """Iterate rows in key order, cloning each (csvplus.go:618-620)."""
+        iterate(self._impl.rows, fn)
+
+    Iterate = iterate
+
+    def __iter__(self):
+        return iter(take_rows(self._impl.rows))
+
+    def __len__(self) -> int:
+        return len(self._impl.rows)
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._impl.columns)
+
+    # -- queries -----------------------------------------------------------
+
+    def find(self, *values: str) -> DataSource:
+        """Lazy source over all rows matching the key-value prefix
+        (csvplus.go:625-627)."""
+        return take_rows(self._impl.find_rows(values))
+
+    def sub_index(self, *values: str) -> "Index":
+        """Index of the rows matching the key prefix, keyed on the
+        remaining columns (csvplus.go:632-641)."""
+        if len(values) >= len(self._impl.columns):
+            raise ValueError("too many values in SubIndex()")
+        return Index(
+            IndexImpl(
+                self._impl.find_rows(values),
+                self._impl.columns[len(values):],
+            )
+        )
+
+    def resolve_duplicates(
+        self, resolve: Callable[[List[Row]], Optional[Row]]
+    ) -> None:
+        """Resolve groups of rows with duplicate keys (csvplus.go:643-653).
+
+        *resolve* receives each duplicate group and returns the single row
+        to keep, an empty row/None to drop the group, or raises to abort.
+        """
+        self._impl.dedup(resolve)
+        self.device_table = None  # stale after mutation
+
+    # -- persistence (csvplus.go:655-705) ----------------------------------
+
+    def write_to(self, file_name: str) -> None:
+        """Persist the index; the file is removed on any write error, like
+        the reference's gob writer (csvplus.go:656-680).
+
+        Format: versioned JSON-lines — a header object, then one row per
+        line.  (A gob-compatible shim is a non-goal; SURVEY.md §5.)
+        """
+        from .sinks import _write_file
+
+        def dump(f):
+            f.write(
+                json.dumps(
+                    {
+                        "magic": _MAGIC,
+                        "version": _VERSION,
+                        "columns": self._impl.columns,
+                        "count": len(self._impl.rows),
+                    }
+                )
+            )
+            f.write("\n")
+            for row in self._impl.rows:
+                f.write(json.dumps(row, sort_keys=True, separators=(",", ":")))
+                f.write("\n")
+
+        _write_file(file_name, dump)
+
+    WriteTo = write_to
+
+    # -- device hook (M3) --------------------------------------------------
+
+    def on_device(self, device: str = "tpu") -> "Index":
+        """Attach an HBM-resident columnar copy of this index so joins and
+        finds against it run as device kernels."""
+        from .columnar.ingest import index_to_device
+
+        self.device_table = index_to_device(self, device=device)
+        return self
+
+    OnDevice = on_device
+
+    # Go-style aliases
+    Find = find
+    SubIndex = sub_index
+    ResolveDuplicates = resolve_duplicates
+
+
+def load_index(file_name: str) -> Index:
+    """Load an index persisted by :meth:`Index.write_to`
+    (csvplus.go:683-705)."""
+    with open(file_name, "r", encoding="utf-8") as f:
+        header = json.loads(f.readline())
+        if header.get("magic") != _MAGIC:
+            raise ValueError(f"{file_name}: not a csvplus-tpu index file")
+        if header.get("version") != _VERSION:
+            raise ValueError(
+                f"{file_name}: unsupported index version {header.get('version')}"
+            )
+        rows = [Row(json.loads(line)) for line in f if line.strip()]
+    if len(rows) != header.get("count"):
+        raise ValueError(
+            f"{file_name}: truncated index file "
+            f"({len(rows)} rows, expected {header.get('count')})"
+        )
+    return Index(IndexImpl(rows, header["columns"]))
+
+
+def create_index(src, columns: Sequence[str]) -> Index:
+    """Materialize and sort an index (csvplus.go:707-738)."""
+    columns = tuple(columns)
+    if len(columns) == 0:
+        raise ValueError("empty column list in CreateIndex()")
+    if len(columns) > 1 and not all_columns_unique(columns):
+        raise ValueError("duplicate column name(s) in CreateIndex()")
+
+    rows: List[Row] = []
+
+    def collect(row: Row) -> None:
+        for col in columns:
+            if col not in row:
+                raise ValueError(f'missing column "{col}" while creating an index')
+        rows.append(row)
+
+    src(collect)
+
+    impl = IndexImpl(rows, columns)
+    impl.sort()
+    return Index(impl)
+
+
+def create_unique_index(src, columns: Sequence[str]) -> Index:
+    """Index build + duplicate-key check (csvplus.go:740-756)."""
+    index = create_index(src, columns)
+    rows = index._impl.rows
+    cols = index._impl.columns
+    for i in range(1, len(rows)):
+        if equal_rows(cols, rows[i - 1], rows[i]):
+            raise CsvPlusError(
+                "duplicate value while creating unique index: "
+                + str(rows[i].select_existing(*cols))
+            )
+    return index

@@ -1,0 +1,175 @@
+"""Symbolic plan IR for device execution.
+
+The reference composes pipelines from *opaque callbacks over row dicts*
+(csvplus.go:262-374).  A TPU cannot execute opaque host callbacks per row,
+so every lazy combinator here additionally tries to record a **symbolic
+plan node**.  When a chain's origin is a device columnar table and every
+stage is symbolic (``Like`` predicates, column projections, windowing
+counts, joins against device indices), sinks hand the whole plan to the
+device executor (:mod:`csvplus_tpu.columnar.exec`) which lowers it to fused
+XLA/Pallas kernels.  The moment an opaque Python callable appears, the plan
+becomes ``None`` and the chain transparently runs on the host streaming
+path — full API parity, device speed only where it's expressible.
+
+Stage helpers return ``None`` (= not device-executable) when either the
+upstream plan is ``None`` or the stage argument is not symbolic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence, Tuple
+
+
+class PlanNode:
+    """Base class for plan IR nodes."""
+
+    __slots__ = ()
+
+    def describe(self, indent: int = 0) -> str:
+        return " " * indent + repr(self)
+
+
+@dataclass(frozen=True)
+class Scan(PlanNode):
+    """Origin: a device columnar table (or a future streaming scan)."""
+
+    table: Any  # columnar.table.DeviceTable
+
+    def __repr__(self) -> str:
+        return f"Scan({self.table.short_desc()})"
+
+
+@dataclass(frozen=True)
+class Filter(PlanNode):
+    child: PlanNode
+    pred: Any  # symbolic predicate (predicates.Like / All / Any / Not)
+
+    def __repr__(self) -> str:
+        return f"Filter({self.pred!r}) <- {self.child!r}"
+
+
+@dataclass(frozen=True)
+class MapExpr(PlanNode):
+    child: PlanNode
+    expr: Any  # symbolic row transform (exprs.Rename / SetValue / ...)
+
+    def __repr__(self) -> str:
+        return f"Map({self.expr!r}) <- {self.child!r}"
+
+
+@dataclass(frozen=True)
+class SelectCols(PlanNode):
+    child: PlanNode
+    columns: Tuple[str, ...]
+
+    def __repr__(self) -> str:
+        return f"Select({list(self.columns)}) <- {self.child!r}"
+
+
+@dataclass(frozen=True)
+class DropCols(PlanNode):
+    child: PlanNode
+    columns: Tuple[str, ...]
+
+    def __repr__(self) -> str:
+        return f"DropCols({list(self.columns)}) <- {self.child!r}"
+
+
+@dataclass(frozen=True)
+class Top(PlanNode):
+    child: PlanNode
+    n: int
+
+
+@dataclass(frozen=True)
+class DropRows(PlanNode):
+    child: PlanNode
+    n: int
+
+
+@dataclass(frozen=True)
+class Join(PlanNode):
+    child: PlanNode
+    index: Any  # index.Index backed by a device table
+    columns: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class Except(PlanNode):
+    child: PlanNode
+    index: Any
+    columns: Tuple[str, ...]
+
+
+def _is_symbolic(obj: Any) -> bool:
+    """A stage argument is symbolic when it opts in via ``__plan_expr__``.
+
+    Combinators like ``All(Like(...), some_python_fn)`` report their own
+    nested symbolic-ness via a ``symbolic`` property.
+    """
+    if getattr(obj, "__plan_expr__", False) is not True:
+        return False
+    return bool(getattr(obj, "symbolic", True))
+
+
+def filter_plan(child: Optional[PlanNode], pred: Any) -> Optional[PlanNode]:
+    if child is not None and _is_symbolic(pred):
+        return Filter(child, pred)
+    return None
+
+
+def map_plan(child: Optional[PlanNode], mf: Any) -> Optional[PlanNode]:
+    if child is not None and _is_symbolic(mf):
+        return MapExpr(child, mf)
+    return None
+
+
+def transform_plan(child: Optional[PlanNode], trans: Any) -> Optional[PlanNode]:
+    # A symbolic transform behaves like a symbolic map for planning purposes.
+    if child is not None and _is_symbolic(trans):
+        return MapExpr(child, trans)
+    return None
+
+
+def select_columns_plan(
+    child: Optional[PlanNode], columns: Sequence[str]
+) -> Optional[PlanNode]:
+    return SelectCols(child, tuple(columns)) if child is not None else None
+
+
+def drop_columns_plan(
+    child: Optional[PlanNode], columns: Sequence[str]
+) -> Optional[PlanNode]:
+    return DropCols(child, tuple(columns)) if child is not None else None
+
+
+def top_plan(child: Optional[PlanNode], n: int) -> Optional[PlanNode]:
+    return Top(child, n) if child is not None else None
+
+
+def drop_plan(child: Optional[PlanNode], n: int) -> Optional[PlanNode]:
+    return DropRows(child, n) if child is not None else None
+
+
+def join_plan(
+    child: Optional[PlanNode], index: Any, columns: Sequence[str]
+) -> Optional[PlanNode]:
+    if child is not None and getattr(index, "device_table", None) is not None:
+        return Join(child, index, tuple(columns))
+    return None
+
+
+def except_plan(
+    child: Optional[PlanNode], index: Any, columns: Sequence[str]
+) -> Optional[PlanNode]:
+    if child is not None and getattr(index, "device_table", None) is not None:
+        return Except(child, index, tuple(columns))
+    return None
+
+
+def explain(plan: Optional[PlanNode]) -> str:
+    """Human-readable plan description; shows where device execution breaks."""
+    if plan is None:
+        return "(host streaming path — no device plan)"
+    return repr(plan)

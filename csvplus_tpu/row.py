@@ -1,0 +1,179 @@
+"""The Row type: one record of a data source.
+
+A ``Row`` is a mapping from column names to string values — columns are
+addressed by name, never by position (reference: ``type Row map[string]string``
+csvplus.go:59 and README.md:76-79).  It subclasses ``dict`` so that plain
+dicts and Rows interoperate freely; all reference accessors (csvplus.go:61-205)
+exist both under Go-style names (``HasColumn``) and Python-style names
+(``has_column``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+
+class MissingColumnError(KeyError):
+    """A named column is absent from a row.
+
+    Message format pinned by the reference: ``missing column %q``
+    (csvplus.go:129, 144, 171).
+    """
+
+    def __init__(self, name: str):
+        self.column = name
+        # KeyError repr-quotes its sole arg; store formatted message instead.
+        super().__init__(name)
+        self._msg = f'missing column "{name}"'
+
+    def __str__(self) -> str:  # noqa: D105
+        return self._msg
+
+
+class ConversionError(ValueError):
+    """A cell value failed a numeric conversion.
+
+    Message format pinned by reference tests (csvplus_test.go:932, 954):
+    ``column "x": cannot convert "v" to integer: invalid syntax``.
+    """
+
+
+class Row(dict):
+    """One line from a data source: column name -> string value."""
+
+    __slots__ = ()
+
+    # -- predicates / safe access (csvplus.go:61-75) ----------------------
+
+    def has_column(self, col: str) -> bool:
+        """True when the specified column is present (csvplus.go:62-65)."""
+        return col in self
+
+    def safe_get_value(self, col: str, subst: str = "") -> str:
+        """Value under *col* if present, else *subst* (csvplus.go:69-75)."""
+        return self.get(col, subst)
+
+    # -- canonical forms (csvplus.go:77-104) ------------------------------
+
+    def header(self) -> List[str]:
+        """All column names, sorted (csvplus.go:78-87)."""
+        return sorted(self.keys())
+
+    def __str__(self) -> str:
+        """Canonical string form (csvplus.go:90-104): sorted-key JSON-ish."""
+        if not self:
+            return "{}"
+        parts = ", ".join(f'"{k}" : "{self[k]}"' for k in self.header())
+        return "{ " + parts + " }"
+
+    def __repr__(self) -> str:  # keep dict repr for debugging
+        return f"Row({dict.__repr__(self)})"
+
+    # -- projection (csvplus.go:106-150) ----------------------------------
+
+    def select_existing(self, *cols: str) -> "Row":
+        """New Row with only the listed columns that exist (csvplus.go:108-118)."""
+        return Row({c: self[c] for c in cols if c in self})
+
+    def select(self, *cols: str) -> "Row":
+        """New Row with exactly the listed columns; raises
+        :class:`MissingColumnError` if any is absent (csvplus.go:122-134)."""
+        r = Row()
+        for c in cols:
+            try:
+                r[c] = self[c]
+            except KeyError:
+                raise MissingColumnError(c) from None
+        return r
+
+    def select_values(self, *cols: str) -> List[str]:
+        """Values of the listed columns in order; raises
+        :class:`MissingColumnError` if any is absent (csvplus.go:138-150)."""
+        try:
+            return [self[c] for c in cols]
+        except KeyError as e:
+            raise MissingColumnError(e.args[0]) from None
+
+    def clone(self) -> "Row":
+        """Shallow copy (csvplus.go:153-161)."""
+        return Row(self)
+
+    # -- typed getters (csvplus.go:163-205) --------------------------------
+
+    def value_as_int(self, column: str) -> int:
+        """Value of *column* as int (csvplus.go:165-183).
+
+        Unlike Python's ``int()``, the reference's ``strconv.Atoi`` rejects
+        surrounding whitespace and underscores; we match that strictness.
+        """
+        if column not in self:
+            raise MissingColumnError(column)
+        val = self[column]
+        if _GO_INT_RE.match(val):
+            try:
+                return int(val, 10)
+            except ValueError:
+                pass
+        raise ConversionError(
+            f'column "{column}": cannot convert "{val}" to integer: invalid syntax'
+        )
+
+    def value_as_float(self, column: str) -> float:
+        """Value of *column* as float (csvplus.go:187-205)."""
+        if column not in self:
+            raise MissingColumnError(column)
+        val = self[column]
+        if _GO_FLOAT_RE.match(val):
+            try:
+                return float(val)
+            except (ValueError, OverflowError):
+                pass
+        raise ConversionError(
+            f'column "{column}": cannot convert "{val}" to float: invalid syntax'
+        )
+
+    # Go-style aliases (the reference API names, csvplus.go:61-205) --------
+    HasColumn = has_column
+    SafeGetValue = safe_get_value
+    Header = header
+    SelectExisting = select_existing
+    Select = select
+    SelectValues = select_values
+    Clone = clone
+    ValueAsInt = value_as_int
+    ValueAsFloat64 = value_as_float
+
+
+import re as _re
+
+# strconv.Atoi: optional sign + decimal digits only.
+_GO_INT_RE = _re.compile(r"^[+-]?[0-9]+$")
+# strconv.ParseFloat accepts decimal/exponent forms, inf/nan, hex floats.
+# We accept the common decimal forms; Python float() covers inf/nan spellings
+# that Go also accepts ("inf", "Infinity", "NaN" case-insensitively).
+_GO_FLOAT_RE = _re.compile(
+    r"^[+-]?((\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?|[iI][nN][fF]([iI][nN][iI][tT][yY])?|[nN][aA][nN])$"
+)
+
+
+def merge_rows(left: Row, right: Row) -> Row:
+    """Merged row; on column-name collision the *right* value wins.
+
+    Reference: ``mergeRows`` csvplus.go:571-583 — Join merges
+    ``(indexRow, streamRow)`` so the stream row's value survives
+    (csvplus.go:560).
+    """
+    r = Row(left)
+    r.update(right)
+    return r
+
+
+def equal_rows(columns: Iterable[str], r1: Row, r2: Row) -> bool:
+    """True when the listed columns have equal values in both rows
+    (reference: ``equalRows`` csvplus.go:759-767)."""
+    return all(r1.get(c) == r2.get(c) for c in columns)
+
+
+def all_columns_unique(columns: Tuple[str, ...]) -> bool:
+    """True when the column list has no duplicates (csvplus.go:770-782)."""
+    return len(set(columns)) == len(columns)

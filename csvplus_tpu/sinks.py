@@ -1,0 +1,133 @@
+"""Eager sinks: the terminals that drive a lazy chain.
+
+Reference: ``ToCsv``/``ToCsvFile`` csvplus.go:376-415, ``ToJSON``/
+``ToJSONFile`` csvplus.go:445-480, ``ToRows`` csvplus.go:483-490, plus the
+atomic ``writeFile`` helper (csvplus.go:418-443): on any error — including
+an exception unwinding through the sink — the partially-written file is
+closed and removed, so sinks never leave partial outputs behind.
+
+When the source carries a device plan (see :mod:`csvplus_tpu.plan`), sinks
+execute the fused device pipeline and stream the result out; otherwise they
+drive the host row-at-a-time path.  Output bytes are identical either way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import IO, List
+
+from .csvio import write_record
+from .errors import StopPipeline
+from .row import Row
+
+
+def _device_rows(src) -> "List[Row] | None":
+    """If the chain is fully symbolic over a device table, execute it on
+    device and return the resulting rows; else None (host path)."""
+    if getattr(src, "plan", None) is None:
+        return None
+    from .columnar.exec import try_execute_plan
+
+    return try_execute_plan(src.plan)
+
+
+def to_csv(src, out: IO[str], *columns: str) -> None:
+    """Write selected columns in canonical CSV form: header line first,
+    fixed arity (csvplus.go:379-406)."""
+    if not columns:
+        raise ValueError("empty column list in ToCsv() function")
+
+    write_record(out, list(columns))
+
+    rows = _device_rows(src)
+    if rows is not None:
+        for row in rows:
+            write_record(out, row.select_values(*columns))
+        return
+
+    def fn(row: Row) -> None:
+        write_record(out, row.select_values(*columns))
+
+    src(fn)
+
+
+def to_csv_file(src, name: str, *columns: str) -> None:
+    """CSV sink to a named file with no-partial-output guarantee
+    (csvplus.go:411-415)."""
+    _write_file(name, lambda f: to_csv(src, f, *columns))
+
+
+def to_json(src, out: IO[str]) -> None:
+    """Stream rows as a JSON array of objects (csvplus.go:446-475).
+
+    Matches the reference's byte format: Go's ``json.Encoder`` emits each
+    object compactly with **sorted keys**, followed by a newline; objects
+    are comma-separated inside ``[...]`` and flushed in ~10KB batches.
+    """
+    buf: List[str] = ["["]
+    buf_len = 1
+    count = 0
+
+    def emit(row: Row) -> None:
+        nonlocal buf_len, count
+        count += 1
+        if count != 1:
+            buf.append(",")
+            buf_len += 1
+        s = (
+            json.dumps(row, sort_keys=True, separators=(",", ":"), ensure_ascii=False)
+            + "\n"
+        )
+        buf.append(s)
+        buf_len += len(s)
+        if buf_len > 10000:
+            out.write("".join(buf))
+            buf.clear()
+            buf_len = 0
+
+    rows = _device_rows(src)
+    if rows is not None:
+        for row in rows:
+            emit(row)
+    else:
+        src(emit)
+
+    buf.append("]")
+    out.write("".join(buf))
+
+
+def to_json_file(src, name: str) -> None:
+    """JSON sink to a named file with no-partial-output guarantee
+    (csvplus.go:478-480)."""
+    _write_file(name, lambda f: to_json(src, f))
+
+
+def to_rows(src) -> List[Row]:
+    """Materialize the source into a list of Rows (csvplus.go:483-490)."""
+    rows = _device_rows(src)
+    if rows is not None:
+        return rows
+
+    out: List[Row] = []
+    src(out.append)
+    return out
+
+
+def _write_file(name: str, fn) -> None:
+    """Create *name*, run *fn(file)*; on ANY failure remove the file
+    (csvplus.go:418-443)."""
+    f = open(name, "w", encoding="utf-8", newline="")
+    try:
+        fn(f)
+        f.close()  # close failure (e.g. ENOSPC flush) also removes the file
+    except BaseException:
+        try:
+            f.close()
+        except OSError:
+            pass
+        try:
+            os.remove(name)
+        except OSError:
+            pass
+        raise

@@ -1,0 +1,421 @@
+"""DataSource: the lazy, composable iteration protocol.
+
+The load-bearing abstraction of the reference (csvplus.go:207-256): a data
+source *is a function* — invoking it pushes rows one at a time into a
+callback.  Here :class:`DataSource` is a callable object so the Go-style
+usage ``src(row_fn)`` works verbatim, while combinators are methods that
+return new lazy sources.  Nothing executes until a sink (or direct call)
+drives the chain.
+
+Semantics preserved from the reference:
+
+* rows yielded from materialized sources are **cloned** before delivery, so
+  consumers may mutate them freely (csvplus.go:225-249, clone at :230);
+* a callback may raise :class:`StopPipeline` (Go: return ``io.EOF``) to stop
+  early without error (csvplus.go:212-214);
+* errors are annotated with row numbers at the *source* level, exactly where
+  the reference wraps them (``iterate`` csvplus.go:242-245 uses the 0-based
+  slice position; the CSV reader uses 1-based file lines, csvplus.go:1102);
+* ``Transform`` drops empty result rows (csvplus.go:265);
+* ``Top`` stops via the EOF mechanism (csvplus.go:319) so upstream readers
+  treat it as a clean stop.
+
+Device execution: each DataSource optionally carries a symbolic ``plan``
+(see :mod:`csvplus_tpu.plan`).  When every stage of a chain is symbolic and
+the origin is a columnar device table, sinks execute the fused device plan
+instead of streaming host rows.  Any opaque Python callback keeps full API
+parity by falling back to the host streaming path.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading as _threading
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+from .errors import DataSourceError, StopPipeline
+from .row import Row, merge_rows
+
+RowFunc = Callable[[Row], None]  # raises to stop/fail (Go: func(Row) error)
+
+
+def iterate(rows: Sequence[Row], fn: RowFunc) -> None:
+    """Drive *fn* over a row slice, cloning each row (csvplus.go:225-249).
+
+    Errors raised by *fn* are wrapped in :class:`DataSourceError` with the
+    0-based position of the offending row, matching the reference's
+    ``Line: uint64(i)``.
+    """
+    i = 0
+    try:
+        for i, row in enumerate(rows):
+            fn(Row(row))  # Row(row) is already a fresh copy
+    except StopPipeline:
+        return
+    except DataSourceError:
+        raise
+    except Exception as e:
+        raise DataSourceError(i, e) from e
+
+
+class DataSource:
+    """A lazy stream of Rows; call it with a row callback to execute.
+
+    Construct from a driver function ``run(fn)`` (Go's ``DataSource`` type,
+    csvplus.go:215) — or use :func:`take_rows` / :func:`take` /
+    :func:`csvplus_tpu.reader.from_file`.
+    """
+
+    __slots__ = ("_run", "plan")
+
+    def __init__(self, run: Callable[[RowFunc], None], plan: Any = None):
+        self._run = run
+        self.plan = plan  # symbolic plan IR node, or None (host-only chain)
+
+    # -- execution ---------------------------------------------------------
+
+    def __call__(self, fn: RowFunc) -> None:
+        """Push every row into *fn*.  *fn* may raise StopPipeline to stop
+        cleanly; any other exception propagates (annotated with a row
+        number by the originating source)."""
+        try:
+            self._run(fn)
+        except StopPipeline:
+            return
+
+    def __iter__(self) -> Iterator[Row]:
+        """Pythonic pull iteration (streaming, bounded buffer).
+
+        The push-based pipeline runs in a helper thread; rows cross through
+        a bounded queue, so memory use stays constant for long streams.
+        Abandoning the iterator stops the producer.
+        """
+        q: _queue.Queue = _queue.Queue(maxsize=1024)
+        _SENTINEL = object()
+        stop = _threading.Event()
+
+        def producer() -> None:
+            try:
+                def fn(row: Row) -> None:
+                    if stop.is_set():
+                        raise StopPipeline
+                    q.put(row)
+
+                self(fn)
+                q.put(_SENTINEL)
+            except BaseException as e:  # propagate to consumer
+                q.put(e)
+
+        t = _threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            # drain so the producer is never blocked on put()
+            while t.is_alive():
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    t.join(timeout=0.05)
+
+    # -- per-row lazy combinators (csvplus.go:258-310) ---------------------
+
+    def transform(self, trans: Callable[[Row], Optional[Row]]) -> "DataSource":
+        """Most generic per-row stage (csvplus.go:262-272).
+
+        *trans* returns the replacement row; an empty dict or ``None`` drops
+        the row; raising stops the iteration.
+        """
+
+        def run(fn: RowFunc) -> None:
+            def step(row: Row) -> None:
+                out = trans(row)
+                if out:
+                    fn(out if isinstance(out, Row) else Row(out))
+
+            self._run(step)
+
+        from .plan import transform_plan
+        return DataSource(run, plan=transform_plan(self.plan, trans))
+
+    def filter(self, pred: Callable[[Row], bool]) -> "DataSource":
+        """Keep rows for which *pred* is true (csvplus.go:276-286)."""
+
+        def run(fn: RowFunc) -> None:
+            def step(row: Row) -> None:
+                if pred(row):
+                    fn(row)
+
+            self._run(step)
+
+        from .plan import filter_plan
+        return DataSource(run, plan=filter_plan(self.plan, pred))
+
+    def map(self, mf: Callable[[Row], Row]) -> "DataSource":
+        """Apply *mf* to every row (csvplus.go:290-296)."""
+
+        def run(fn: RowFunc) -> None:
+            def step(row: Row) -> None:
+                out = mf(row)
+                fn(out if isinstance(out, Row) else Row(out))
+
+            self._run(step)
+
+        from .plan import map_plan
+        return DataSource(run, plan=map_plan(self.plan, mf))
+
+    def validate(self, vf: Callable[[Row], None]) -> "DataSource":
+        """Check every row; *vf* raises to fail the pipeline at that row
+        (csvplus.go:300-310)."""
+
+        def run(fn: RowFunc) -> None:
+            def step(row: Row) -> None:
+                vf(row)
+                fn(row)
+
+            self._run(step)
+
+        return DataSource(run)
+
+    # -- windowing combinators (csvplus.go:312-374) ------------------------
+
+    def top(self, n: int) -> "DataSource":
+        """Pass down at most *n* rows, then stop cleanly (csvplus.go:313-326)."""
+
+        def run(fn: RowFunc) -> None:
+            counter = n
+
+            def step(row: Row) -> None:
+                nonlocal counter
+                if counter == 0:
+                    raise StopPipeline
+                counter -= 1
+                fn(row)
+
+            self._run(step)
+
+        from .plan import top_plan
+        return DataSource(run, plan=top_plan(self.plan, n))
+
+    def drop(self, n: int) -> "DataSource":
+        """Skip the first *n* rows (csvplus.go:329-342)."""
+
+        def run(fn: RowFunc) -> None:
+            counter = n
+
+            def step(row: Row) -> None:
+                nonlocal counter
+                if counter == 0:
+                    fn(row)
+                else:
+                    counter -= 1
+
+            self._run(step)
+
+        from .plan import drop_plan
+        return DataSource(run, plan=drop_plan(self.plan, n))
+
+    def take_while(self, pred: Callable[[Row], bool]) -> "DataSource":
+        """Pass rows until *pred* is first false, then stop (csvplus.go:346-358)."""
+
+        def run(fn: RowFunc) -> None:
+            def step(row: Row) -> None:
+                if not pred(row):
+                    raise StopPipeline
+                fn(row)
+
+            self._run(step)
+
+        return DataSource(run)
+
+    def drop_while(self, pred: Callable[[Row], bool]) -> "DataSource":
+        """Skip rows while *pred* holds, then pass everything (csvplus.go:362-374)."""
+
+        def run(fn: RowFunc) -> None:
+            yielding = False
+
+            def step(row: Row) -> None:
+                nonlocal yielding
+                if not yielding and pred(row):
+                    return
+                yielding = True
+                fn(row)
+
+            self._run(step)
+
+        return DataSource(run)
+
+    # -- column projection (csvplus.go:492-525) ----------------------------
+
+    def drop_columns(self, *columns: str) -> "DataSource":
+        """Remove the listed columns from each row (csvplus.go:493-507)."""
+        if not columns:
+            raise ValueError("no columns specified in DropColumns()")
+
+        def run(fn: RowFunc) -> None:
+            def step(row: Row) -> None:
+                for c in columns:
+                    row.pop(c, None)
+                fn(row)
+
+            self._run(step)
+
+        from .plan import drop_columns_plan
+        return DataSource(run, plan=drop_columns_plan(self.plan, columns))
+
+    def select_columns(self, *columns: str) -> "DataSource":
+        """Keep exactly the listed columns; error if any is missing
+        (csvplus.go:511-525)."""
+        if not columns:
+            raise ValueError("no columns specified in SelectColumns()")
+
+        def run(fn: RowFunc) -> None:
+            def step(row: Row) -> None:
+                fn(row.select(*columns))
+
+            self._run(step)
+
+        from .plan import select_columns_plan
+        return DataSource(run, plan=select_columns_plan(self.plan, columns))
+
+    # -- index / join entry points (implemented in index.py) ---------------
+
+    def index_on(self, *columns: str):
+        """Materialize a sorted :class:`~csvplus_tpu.index.Index` on the
+        listed key columns (csvplus.go:529-531)."""
+        from .index import create_index
+
+        return create_index(self, columns)
+
+    def unique_index_on(self, *columns: str):
+        """Like :meth:`index_on` but errors on duplicate keys
+        (csvplus.go:535-537)."""
+        from .index import create_unique_index
+
+        return create_unique_index(self, columns)
+
+    def join(self, index, *columns: str) -> "DataSource":
+        """Lazy lookup join against *index* (csvplus.go:539-569).
+
+        The listed stream columns match the index's key columns left to
+        right; with no columns given, the index's own key column names are
+        used ("natural join").  Merged rows contain all columns from both
+        sides; on a name collision the **stream row's value wins**
+        (csvplus.go:560, 571-583).
+        """
+        cols = _resolve_join_columns(index, columns, "Join()")
+
+        def run(fn: RowFunc) -> None:
+            def step(row: Row) -> None:
+                values = row.select_values(*cols)
+                for index_row in index._impl.find_rows(values):
+                    fn(merge_rows(index_row, row))
+
+            self._run(step)
+
+        from .plan import join_plan
+        return DataSource(run, plan=join_plan(self.plan, index, cols))
+
+    def except_(self, index, *columns: str) -> "DataSource":
+        """Anti-join: pass through rows whose key is NOT in *index*
+        (csvplus.go:585-608)."""
+        cols = _resolve_join_columns(index, columns, "Except()")
+
+        def run(fn: RowFunc) -> None:
+            def step(row: Row) -> None:
+                values = row.select_values(*cols)
+                if not index._impl.has(values):
+                    fn(row)
+
+            self._run(step)
+
+        from .plan import except_plan
+        return DataSource(run, plan=except_plan(self.plan, index, cols))
+
+    # -- sinks (implemented in sinks.py) -----------------------------------
+
+    def to_csv(self, out, *columns: str) -> None:
+        from .sinks import to_csv
+
+        to_csv(self, out, *columns)
+
+    def to_csv_file(self, name: str, *columns: str) -> None:
+        from .sinks import to_csv_file
+
+        to_csv_file(self, name, *columns)
+
+    def to_json(self, out) -> None:
+        from .sinks import to_json
+
+        to_json(self, out)
+
+    def to_json_file(self, name: str) -> None:
+        from .sinks import to_json_file
+
+        to_json_file(self, name)
+
+    def to_rows(self) -> List[Row]:
+        from .sinks import to_rows
+
+        return to_rows(self)
+
+    # -- Go-style aliases --------------------------------------------------
+    Transform = transform
+    Filter = filter
+    Map = map
+    Validate = validate
+    Top = top
+    Drop = drop
+    TakeWhile = take_while
+    DropWhile = drop_while
+    DropColumns = drop_columns
+    SelectColumns = select_columns
+    IndexOn = index_on
+    UniqueIndexOn = unique_index_on
+    Join = join
+    Except = except_
+    ToCsv = to_csv
+    ToCsvFile = to_csv_file
+    ToJSON = to_json
+    ToJSONFile = to_json_file
+    ToRows = to_rows
+
+
+def _resolve_join_columns(index, columns: Sequence[str], what: str) -> List[str]:
+    """Shared Join/Except column-list resolution (csvplus.go:546-550, 589-593)."""
+    if not columns:
+        return list(index._impl.columns)
+    if len(columns) > len(index._impl.columns):
+        raise ValueError(f"too many source columns in {what}")
+    return list(columns)
+
+
+def take_rows(rows: Iterable[Row]) -> DataSource:
+    """Convert a list of Rows to a DataSource (csvplus.go:218-222).
+
+    Rows are cloned on every iteration, so consumers may mutate them.
+    """
+    rows = list(rows)
+
+    def run(fn: RowFunc) -> None:
+        iterate(rows, fn)
+
+    return DataSource(run)
+
+
+def take(src: Any) -> DataSource:
+    """Lift anything with an ``iterate(fn)``/``Iterate(fn)`` method — a
+    Reader, an Index, a DeviceTable — into a DataSource (csvplus.go:252-256)."""
+    if isinstance(src, DataSource):
+        return src
+    it = getattr(src, "iterate", None) or getattr(src, "Iterate", None)
+    if it is None:
+        raise TypeError(f"take(): {type(src).__name__} has no iterate() method")
+    return DataSource(it, plan=getattr(src, "plan", None))

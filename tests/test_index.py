@@ -1,0 +1,252 @@
+"""Index build/search/join/sub-index/persistence.
+
+Covers reference tests: TestIndexImpl (csvplus_test.go:198-246), TestSorted
+(:454-514), TestSimpleUniqueJoin (:368-452), TestSimpleTotals (:516-571),
+TestMultiIndex (:573-649), TestExcept (:651-693), TestIndexStore
+(:960-1014), TestLongChain's non-mutation contract (:325-365), and the
+TestErrors index paths (:808-909).
+"""
+
+import pytest
+
+from csvplus_tpu import (
+    CsvPlusError,
+    DataSourceError,
+    Like,
+    Row,
+    Take,
+    TakeRows,
+    from_file,
+    load_index,
+)
+
+
+@pytest.fixture()
+def people_src(people_csv):
+    return Take(from_file(people_csv).select_columns("id", "name", "surname"))
+
+
+@pytest.fixture()
+def orders_src(orders_csv):
+    return Take(from_file(orders_csv).select_columns("cust_id", "prod_id", "qty", "ts"))
+
+
+# -- build + sort order ---------------------------------------------------
+
+
+def test_index_sorted_iteration(people_src):
+    index = people_src.index_on("surname", "name")
+    rows = Take(index).to_rows()
+    assert len(rows) == 120
+    keys = [(r["surname"], r["name"]) for r in rows]
+    assert keys == sorted(keys)
+
+
+def test_index_on_missing_column(people_src):
+    with pytest.raises(DataSourceError) as e:
+        people_src.index_on("name", "xxx")
+    # pinned (csvplus_test.go:830)
+    assert str(e.value).endswith('missing column "xxx" while creating an index')
+
+
+def test_index_on_empty_columns_panics(people_src):
+    with pytest.raises(ValueError):
+        people_src.index_on()
+
+
+def test_index_on_duplicate_columns_panics(people_src):
+    with pytest.raises(ValueError):
+        people_src.index_on("id", "id")
+
+
+def test_unique_index_duplicate_error(people_src):
+    with pytest.raises(CsvPlusError) as e:
+        people_src.unique_index_on("name")
+    # pinned (csvplus_test.go:838)
+    assert "duplicate value while creating unique index:" in str(e.value)
+
+
+def test_unique_index_ok(people_src):
+    index = people_src.unique_index_on("id")
+    assert len(index) == 120
+
+
+# -- find / sub-index -----------------------------------------------------
+
+
+def test_find(people_src):
+    index = people_src.index_on("name", "surname")
+    rows = index.find("Amelia").to_rows()
+    assert len(rows) == 12
+    assert all(r["name"] == "Amelia" for r in rows)
+    rows = index.find("Amelia", "Smith").to_rows()
+    assert len(rows) == 1
+    assert index.find("NoSuch").to_rows() == []
+    # no values = all rows
+    assert len(index.find().to_rows()) == 120
+
+
+def test_find_too_many_values(people_src):
+    index = people_src.index_on("name")
+    with pytest.raises(ValueError):
+        index.find("a", "b").to_rows()
+
+
+def test_sub_index(people_src):
+    index = people_src.index_on("name", "surname")
+    sub = index.sub_index("Olivia")
+    assert sub.columns == ["surname"]
+    assert len(sub) == 12
+    rows = sub.find("Jones").to_rows()
+    assert len(rows) == 1 and rows[0]["name"] == "Olivia"
+    with pytest.raises(ValueError):
+        index.sub_index("a", "b")  # too many values (csvplus_test.go:878-880)
+
+
+def test_index_find_returns_lazy_clone(people_src):
+    index = people_src.index_on("id")
+    rows = index.find("5").to_rows()
+    rows[0]["name"] = "MUTATED"
+    # the index itself must be unchanged
+    again = index.find("5").to_rows()
+    assert again[0]["name"] != "MUTATED"
+
+
+# -- joins ----------------------------------------------------------------
+
+
+def test_join_counts_and_collision(people_src, orders_src, corpus):
+    """orders ⋈ people: row count preserved, 6 columns survive — cust_id
+    and id both present (csvplus_test.go:425-427)."""
+    cust = people_src.unique_index_on("id")
+    joined = orders_src.join(cust, "cust_id").to_rows()
+    assert len(joined) == len(corpus["orders"])
+    assert set(joined[0].keys()) == {"cust_id", "prod_id", "qty", "ts", "id", "name", "surname"} - {""}
+    # collision semantics: Join merges (indexRow, streamRow): stream wins.
+    # Here column sets only overlap via none -> 7 columns total.
+    assert len(joined[0]) == 7
+
+
+def test_join_natural_columns(stock_csv, orders_src):
+    """Natural join: no columns given -> index's key columns
+    (csvplus.go:546-548; README.md:56)."""
+    prod = Take(from_file(stock_csv).select_columns("prod_id", "product", "price")).unique_index_on("prod_id")
+    joined = orders_src.join(prod).to_rows()
+    assert len(joined) == 10_000
+    assert "product" in joined[0] and "qty" in joined[0]
+
+
+def test_join_does_not_mutate_index(people_src, orders_src):
+    """Pinned by TestLongChain (csvplus_test.go:325-365)."""
+    cust = people_src.unique_index_on("id")
+    before = Take(cust).to_rows()
+    orders_src.join(cust, "cust_id").top(100).to_rows()
+    assert Take(cust).to_rows() == before
+
+
+def test_join_stream_value_wins(people_src):
+    """On column collision the stream row's value survives (csvplus.go:560)."""
+    idx = TakeRows([Row({"k": "1", "v": "index"})]).index_on("k")
+    out = TakeRows([Row({"k": "1", "v": "stream"})]).join(idx, "k").to_rows()
+    assert out == [Row({"k": "1", "v": "stream"})]
+
+
+def test_join_fanout_non_unique_index(people_src):
+    """Non-unique index: one stream row merges with every match."""
+    idx = people_src.index_on("name")  # 12 rows per name
+    stream = TakeRows([Row({"name": "Amelia", "tag": "x"})])
+    out = stream.join(idx, "name").to_rows()
+    assert len(out) == 12
+    assert all(r["tag"] == "x" for r in out)
+
+
+def test_join_too_many_columns_panics(people_src):
+    idx = people_src.index_on("name")
+    with pytest.raises(ValueError):
+        TakeRows([]).join(idx, "a", "b")
+
+
+def test_join_missing_stream_column(people_src, orders_src):
+    idx = people_src.unique_index_on("id")
+    with pytest.raises(DataSourceError):
+        orders_src.join(idx, "nonexistent").to_rows()
+
+
+def test_three_way_join_totals(people_src, orders_src, stock_csv, corpus):
+    """README's 3-table join with per-customer totals checked against the
+    oracle (TestSimpleTotals csvplus_test.go:516-571)."""
+    cust = people_src.unique_index_on("id")
+    prod = Take(
+        from_file(stock_csv).select_columns("prod_id", "product", "price")
+    ).unique_index_on("prod_id")
+
+    totals = {}
+    for row in orders_src.join(cust, "cust_id").join(prod):
+        cid = int(row["cust_id"])
+        totals[cid] = totals.get(cid, 0.0) + int(row["qty"]) * float(row["price"])
+
+    oracle = {}
+    for o in corpus["orders"]:
+        oracle[o.cust_id] = (
+            oracle.get(o.cust_id, 0.0) + o.qty * corpus["stock"][o.prod_id][1]
+        )
+    assert set(totals) == set(oracle)
+    for cid in oracle:
+        assert abs(totals[cid] - oracle[cid]) / oracle[cid] < 1e-6
+
+
+# -- except (anti-join) ---------------------------------------------------
+
+
+def test_except(people_src, orders_src, corpus):
+    """Anti-join vs recomputed oracle (TestExcept csvplus_test.go:651-693)."""
+    some_customers = people_src.filter(Like({"name": "Amelia"})).index_on("id")
+    rest = orders_src.except_(some_customers, "cust_id").to_rows()
+    amelia_ids = {
+        i for i, p in enumerate(corpus["people"]) if p.name == "Amelia"
+    }
+    expected = sum(1 for o in corpus["orders"] if o.cust_id not in amelia_ids)
+    assert len(rest) == expected
+    assert all(int(r["cust_id"]) not in amelia_ids for r in rest)
+
+
+# -- persistence ----------------------------------------------------------
+
+
+def test_index_store_roundtrip(people_src, tmp_path):
+    """WriteTo -> LoadIndex -> deep compare (TestIndexStore
+    csvplus_test.go:960-1014)."""
+    index = people_src.index_on("id")
+    path = str(tmp_path / "people.index")
+    index.write_to(path)
+    index2 = load_index(path)
+    assert index2.columns == index.columns
+    assert Take(index2).to_rows() == Take(index).to_rows()
+
+
+def test_index_store_removed_on_error(people_src, tmp_path, monkeypatch):
+    """No partial index files on write error (csvplus.go:656-671)."""
+    import csvplus_tpu.index as idx_mod
+
+    index = people_src.index_on("id")
+    path = str(tmp_path / "bad.index")
+
+    class Boom(RuntimeError):
+        pass
+
+    def bad_dumps(*a, **k):
+        raise Boom("disk full simulation")
+
+    monkeypatch.setattr(idx_mod.json, "dumps", bad_dumps)
+    with pytest.raises(Boom):
+        index.write_to(path)
+    import os
+
+    assert not os.path.exists(path)
+
+
+def test_load_index_rejects_garbage(tmp_path):
+    p = tmp_path / "junk"
+    p.write_text('{"magic": "nope"}\n')
+    with pytest.raises(ValueError):
+        load_index(str(p))

@@ -1,0 +1,215 @@
+"""CSV Reader: header policies, field-count policies, quoting, comments.
+
+Covers the reference's reader configuration surface (csvplus.go:922-1206)
+and the pinned error messages of TestErrors (csvplus_test.go:808-909).
+"""
+
+import io
+
+import pytest
+
+from csvplus_tpu import DataSourceError, Row, Take, from_file, from_reader
+from csvplus_tpu.csvio import CsvParseError, parse_records
+
+
+def rows_from(text, **cfg):
+    r = from_reader(io.StringIO(text))
+    for name, arg in cfg.items():
+        attr = getattr(r, name)
+        r = attr(*arg) if isinstance(arg, tuple) else attr(arg)
+    return Take(r).to_rows()
+
+
+# -- header policies ------------------------------------------------------
+
+
+def test_auto_header():
+    out = rows_from("a,b\n1,2\n3,4\n")
+    assert out == [Row({"a": "1", "b": "2"}), Row({"a": "3", "b": "4"})]
+
+
+def test_select_columns_at_source(people_csv):
+    out = Take(from_file(people_csv).select_columns("id", "name")).top(1).to_rows()
+    assert set(out[0].keys()) == {"id", "name"}
+
+
+def test_select_columns_missing():
+    with pytest.raises(DataSourceError) as e:
+        rows_from("a,b\n1,2\n", select_columns=("a", "xxx"))
+    # pinned: "row 1: column not found: xxx" (csvplus_test.go:812)
+    assert str(e.value) == "row 1: column not found: xxx"
+
+
+def test_select_columns_multiple_missing():
+    with pytest.raises(DataSourceError) as e:
+        rows_from("a,b\n1,2\n", select_columns=("a", "xxx", "yyy"))
+    assert str(e.value) == "row 1: columns not found: xxx, yyy"
+
+
+def test_select_columns_duplicate_panics():
+    r = from_reader(io.StringIO("a,b\n"))
+    with pytest.raises(ValueError) as e:
+        r.select_columns("a", "b", "a")
+    assert "duplicate column name: a" in str(e.value)
+
+
+def test_expect_header_ok():
+    out = rows_from(
+        "a,b,c\n1,2,3\n", expect_header={"a": 0, "c": -1}
+    )
+    assert out == [Row({"a": "1", "c": "3"})]
+
+
+def test_expect_header_misplaced():
+    with pytest.raises(DataSourceError) as e:
+        rows_from("id,name,surname\n0,x,y\n", expect_header={"name": 1, "surname": 3})
+    # pinned (csvplus_test.go:893)
+    assert str(e.value).endswith(
+        'row 1: misplaced column "surname": expected at pos. 3, but found at pos. 2'
+    )
+
+
+def test_expect_header_nonexistent_position():
+    with pytest.raises(DataSourceError) as e:
+        rows_from("id,name,surname\n0,x,y\n", expect_header={"name": 1, "surname": 25})
+    # pinned (csvplus_test.go:905)
+    assert str(e.value).endswith(
+        'row 1: misplaced column "surname": expected at pos. 25, but found at pos. 2'
+    )
+
+
+def test_assume_header():
+    out = rows_from("1,2,3\n4,5,6\n", assume_header={"x": 0, "z": 2})
+    assert out == [Row({"x": "1", "z": "3"}), Row({"x": "4", "z": "6"})]
+
+
+def test_assume_header_validation():
+    r = from_reader(io.StringIO(""))
+    with pytest.raises(ValueError):
+        r.assume_header({})
+    with pytest.raises(ValueError):
+        r.assume_header({"x": -1})
+
+
+def test_empty_input_auto_header():
+    with pytest.raises(DataSourceError) as e:
+        rows_from("")
+    assert e.value.line == 1  # "row 1: EOF"
+
+
+# -- field-count policies -------------------------------------------------
+
+
+def test_num_fields_auto_mismatch():
+    with pytest.raises(DataSourceError) as e:
+        rows_from("a,b\n1,2\n1,2,3\n")
+    # record 3 of the file; message pinned to Go's csv error text
+    assert str(e.value) == "row 3: wrong number of fields"
+
+
+def test_num_fields_exact():
+    with pytest.raises(DataSourceError):
+        rows_from("a,b\n1,2\n", num_fields=3)
+    out = rows_from("a,b\n1,2\n", num_fields=2)
+    assert out == [Row({"a": "1", "b": "2"})]
+
+
+def test_num_fields_any_pads():
+    """Short rows are right-padded with empty fields (csvplus.go:1121-1124)."""
+    out = rows_from(
+        "1,2,3\n4\n", assume_header={"x": 0, "z": 2}, num_fields_any=()
+    )
+    assert out == [Row({"x": "1", "z": "3"}), Row({"x": "4", "z": ""})]
+
+
+def test_missing_column_strict():
+    # with auto field count the short row errors as "wrong number of fields"
+    with pytest.raises(DataSourceError) as e:
+        rows_from("1,2,3\n4\n", assume_header={"x": 0, "z": 2})
+    assert "wrong number of fields" in str(e.value)
+
+
+# -- parsing options ------------------------------------------------------
+
+
+def test_delimiter_and_comment():
+    out = rows_from(
+        "# a comment line\na;b\n1;2\n# another\n3;4\n",
+        delimiter=";",
+        comment_char="#",
+    )
+    assert out == [Row({"a": "1", "b": "2"}), Row({"a": "3", "b": "4"})]
+
+
+def test_blank_lines_skipped():
+    out = rows_from("a,b\n\n1,2\n\r\n3,4\n")
+    assert len(out) == 2
+
+
+def test_quoted_fields():
+    out = rows_from('a,b\n"x,y",2\n"say ""hi""",4\n')
+    assert out[0]["a"] == "x,y"
+    assert out[1]["a"] == 'say "hi"'
+
+
+def test_quoted_multiline_field():
+    out = rows_from('a,b\n"line1\nline2",2\n')
+    assert out[0]["a"] == "line1\nline2"
+
+
+def test_trim_leading_space():
+    out = rows_from("a,b\n  1, 2\n", trim_leading_space=())
+    assert out == [Row({"a": "1", "b": "2"})]
+    # without trimming, spaces are data
+    out = rows_from("a,b\n  1, 2\n")
+    assert out == [Row({"a": "  1", "b": " 2"})]
+
+
+def test_bare_quote_error_and_lazy_quotes():
+    with pytest.raises(DataSourceError) as e:
+        rows_from('a,b\nx"y,2\n')
+    assert 'bare " in non-quoted field' in str(e.value)
+    out = rows_from('a,b\nx"y,2\n', lazy_quotes=())
+    assert out[0]["a"] == 'x"y'
+
+
+def test_stray_quote_in_quoted_field():
+    with pytest.raises(DataSourceError) as e:
+        rows_from('a,b\n"x"y,2\n')
+    assert 'extraneous or missing " in quoted-field' in str(e.value)
+    out = rows_from('a,b\n"x"y",2\n', lazy_quotes=())
+    assert out[0]["a"] == 'x"y'
+
+
+def test_unterminated_quote():
+    with pytest.raises(DataSourceError):
+        rows_from('a,b\n"never closed,2\n')
+
+
+def test_trailing_delimiter_empty_field():
+    assert list(parse_records(io.StringIO("1,2,\n"))) == [["1", "2", ""]]
+    assert list(parse_records(io.StringIO("1,,3\n"))) == [["1", "", "3"]]
+
+
+def test_no_trailing_newline():
+    assert list(parse_records(io.StringIO("1,2"))) == [["1", "2"]]
+
+
+def test_crlf_terminators():
+    assert list(parse_records(io.StringIO("1,2\r\n3,4\r\n"))) == [
+        ["1", "2"],
+        ["3", "4"],
+    ]
+
+
+def test_file_not_found():
+    with pytest.raises(DataSourceError) as e:
+        Take(from_file("/nonexistent/file.csv")).to_rows()
+    assert str(e.value).startswith("row 1: open: ")
+
+
+def test_file_reader_reiterable(people_csv):
+    src = Take(from_file(people_csv))
+    a = src.to_rows()
+    b = src.to_rows()
+    assert a == b and len(a) == 120

@@ -1,0 +1,108 @@
+"""ResolveDuplicates: randomized property test + pinned group semantics.
+
+Mirrors TestResolver (csvplus_test.go:695-752) — inject 1..100 copies of a
+random row, assert the resolver sees exactly one group of exactly n+1
+identical rows — plus the all-duplicates case from TestErrors
+(csvplus_test.go:850-863), and a regression test for the intentional
+divergence: the reference drops the final singleton row after a duplicate
+group (csvplus.go:842,851-859); we keep it.
+"""
+
+import random
+
+import pytest
+
+from csvplus_tpu import Row, Take, TakeRows, from_file
+
+from conftest import PEOPLE_NAMES, PEOPLE_SURNAMES
+
+
+@pytest.fixture()
+def people_rows(people_csv):
+    return Take(
+        from_file(people_csv).select_columns("id", "name", "surname")
+    ).to_rows()
+
+
+def test_resolver_randomized(people_rows):
+    rng = random.Random(7)
+    for _ in range(200):  # reference runs 1000; 200 keeps the suite fast
+        src = list(people_rows)
+        dup = src[rng.randrange(len(src))]
+        n = rng.randrange(100) + 1
+        for _ in range(n):
+            k = rng.randrange(len(src))
+            src.append(dup)
+            src[k], src[-1] = src[-1], src[k]
+
+        index = TakeRows(src).index_on("name", "surname")
+        calls = []
+
+        def resolve(rows):
+            calls.append(len(rows))
+            assert all(
+                r["id"] == dup["id"]
+                and r["name"] == dup["name"]
+                and r["surname"] == dup["surname"]
+                for r in rows
+            )
+            return rows[0]
+
+        index.resolve_duplicates(resolve)
+        assert calls == [n + 1]
+        # every original row must survive exactly once
+        assert len(index) == len(people_rows)
+
+
+def test_resolver_all_duplicates(people_rows):
+    """IndexOn(name): 10 groups of 12; keep one per group
+    (TestErrors csvplus_test.go:845-863)."""
+    index = TakeRows(people_rows).index_on("name")
+
+    def resolve(rows):
+        assert len(rows) == len(PEOPLE_SURNAMES)
+        return rows[0]
+
+    index.resolve_duplicates(resolve)
+    assert len(index) == len(PEOPLE_NAMES)
+
+
+def test_resolver_drop_group():
+    """An empty returned row drops the whole group (csvplus.go:648,845)."""
+    rows = [Row({"k": "a", "v": str(i)}) for i in range(3)] + [
+        Row({"k": "b", "v": "x"})
+    ]
+    index = TakeRows(rows).index_on("k")
+    index.resolve_duplicates(lambda group: Row())
+    out = Take(index).to_rows()
+    assert [r["k"] for r in out] == ["b"]
+
+
+def test_resolver_error_aborts():
+    rows = [Row({"k": "a"}), Row({"k": "a"})]
+    index = TakeRows(rows).index_on("k")
+
+    class Nope(RuntimeError):
+        pass
+
+    with pytest.raises(Nope):
+        index.resolve_duplicates(lambda g: (_ for _ in ()).throw(Nope()))
+
+
+def test_resolver_keeps_trailing_singleton():
+    """DIVERGENCE (intentional): with sorted rows [A,A,B], the reference's
+    in-place compaction loses B (csvplus.go:842 sets lower=upper+1 and the
+    flush loop :851-859 never emits the final pending row).  We keep B."""
+    rows = [Row({"k": "a", "v": "1"}), Row({"k": "a", "v": "2"}), Row({"k": "b", "v": "3"})]
+    index = TakeRows(rows).index_on("k")
+    index.resolve_duplicates(lambda g: g[0])
+    out = Take(index).to_rows()
+    assert [r["k"] for r in out] == ["a", "b"]
+
+
+def test_resolver_no_duplicates_untouched(people_rows):
+    index = TakeRows(people_rows).index_on("id")
+    index.resolve_duplicates(
+        lambda g: (_ for _ in ()).throw(AssertionError("must not be called"))
+    )
+    assert len(index) == len(people_rows)

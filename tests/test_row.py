@@ -1,0 +1,104 @@
+"""Row accessors and typed getters — reference TestRow
+(csvplus_test.go:49-116) and TestNumericalConversions (:911-958)."""
+
+import pytest
+
+from csvplus_tpu import ConversionError, MissingColumnError, Row
+
+
+@pytest.fixture()
+def row():
+    return Row({"id": "42", "name": "Amelia", "surname": "Smith"})
+
+
+def test_has_column(row):
+    assert row.has_column("id")
+    assert row.has_column("name")
+    assert not row.has_column("xxx")
+    assert row.HasColumn("surname")  # Go-style alias
+
+
+def test_safe_get_value(row):
+    assert row.safe_get_value("name", "?") == "Amelia"
+    assert row.safe_get_value("xxx", "?") == "?"
+    assert row.SafeGetValue("xxx", "") == ""
+
+
+def test_header_sorted(row):
+    assert row.header() == ["id", "name", "surname"]
+
+
+def test_string_canonical_form(row):
+    # reference Row.String() (csvplus.go:90-104): sorted keys, quoted
+    assert str(row) == '{ "id" : "42", "name" : "Amelia", "surname" : "Smith" }'
+    assert str(Row()) == "{}"
+
+
+def test_select_existing(row):
+    r = row.select_existing("id", "xxx", "name")
+    assert r == {"id": "42", "name": "Amelia"}
+
+
+def test_select(row):
+    r = row.select("id", "name")
+    assert r == {"id": "42", "name": "Amelia"}
+    with pytest.raises(MissingColumnError) as e:
+        row.select("id", "xxx")
+    assert str(e.value) == 'missing column "xxx"'
+
+
+def test_select_values(row):
+    assert row.select_values("name", "id") == ["Amelia", "42"]
+    with pytest.raises(MissingColumnError):
+        row.select_values("name", "nope")
+
+
+def test_clone_independent(row):
+    c = row.clone()
+    assert c == row
+    c["id"] = "0"
+    assert row["id"] == "42"
+
+
+def test_value_as_int():
+    row = Row({"int": "12345", "float": "3.1415926", "string": "xyz"})
+    assert row.value_as_int("int") == 12345
+    with pytest.raises(ConversionError) as e:
+        row.value_as_int("string")
+    # message pinned by csvplus_test.go:932
+    assert str(e.value) == 'column "string": cannot convert "xyz" to integer: invalid syntax'
+    with pytest.raises(MissingColumnError):
+        row.value_as_int("nope")
+    # Go strconv.Atoi rejects floats and spaces
+    with pytest.raises(ConversionError):
+        row.value_as_int("float")
+    assert Row({"x": "-7"}).value_as_int("x") == -7
+    assert Row({"x": "+7"}).value_as_int("x") == 7
+    with pytest.raises(ConversionError):
+        Row({"x": " 7"}).value_as_int("x")
+    with pytest.raises(ConversionError):
+        Row({"x": "1_000"}).value_as_int("x")
+
+
+def test_value_as_float():
+    row = Row({"float": "3.1415926", "string": "xyz"})
+    assert abs(row.value_as_float("float") - 3.1415926) < 1e-9
+    with pytest.raises(ConversionError) as e:
+        row.value_as_float("string")
+    # message pinned by csvplus_test.go:954
+    assert str(e.value) == 'column "string": cannot convert "xyz" to float: invalid syntax'
+    assert Row({"x": "1e3"}).value_as_float("x") == 1000.0
+    assert Row({"x": ".5"}).value_as_float("x") == 0.5
+    with pytest.raises(ConversionError):
+        Row({"x": ""}).value_as_float("x")
+
+
+def test_merge_rows_right_wins():
+    from csvplus_tpu import merge_rows
+
+    left = Row({"a": "1", "b": "2"})
+    right = Row({"b": "9", "c": "3"})
+    m = merge_rows(left, right)
+    # stream (right) value wins on collision — csvplus.go:560, 571-583
+    assert m == {"a": "1", "b": "9", "c": "3"}
+    assert left == {"a": "1", "b": "2"}  # inputs untouched

@@ -1,0 +1,209 @@
+"""DataSource protocol + lazy combinators.
+
+Covers the reference's TestSimpleDataSource (csvplus_test.go:118-151),
+TestFilterMap (:153-170), windowing (Top/Drop/TakeWhile/DropWhile from
+TestSorted :454-514), Transform/Validate semantics, clone-on-iterate, and
+StopPipeline early termination.
+"""
+
+import pytest
+
+from csvplus_tpu import (
+    All,
+    Any,
+    DataSourceError,
+    Like,
+    Not,
+    Row,
+    StopPipeline,
+    Take,
+    TakeRows,
+    from_file,
+    take_rows,
+)
+
+
+def rows_of(*dicts):
+    return [Row(d) for d in dicts]
+
+
+@pytest.fixture()
+def nums():
+    return rows_of(*[{"n": str(i), "mod": str(i % 3)} for i in range(10)])
+
+
+def test_take_rows_roundtrip(nums):
+    assert take_rows(nums).to_rows() == nums
+
+
+def test_clone_on_iterate(nums):
+    """Mutating a yielded row must not corrupt the source (csvplus.go:230)."""
+    src = take_rows(nums)
+
+    def mutate(row):
+        row["n"] = "XXX"
+
+    src(mutate)
+    assert nums[0]["n"] == "0"
+    assert src.to_rows() == nums
+
+
+def test_early_stop(nums):
+    """A callback raising StopPipeline stops cleanly (io.EOF analogue)."""
+    seen = []
+
+    def fn(row):
+        seen.append(row)
+        if len(seen) == 3:
+            raise StopPipeline
+
+    take_rows(nums)(fn)
+    assert len(seen) == 3
+
+
+def test_callback_error_is_wrapped_with_row_number(nums):
+    def fn(row):
+        if row["n"] == "4":
+            raise RuntimeError("boom")
+
+    with pytest.raises(DataSourceError) as e:
+        take_rows(nums)(fn)
+    # iterate() wraps with the 0-based slice position (csvplus.go:242-245)
+    assert e.value.line == 4
+    assert "boom" in str(e.value)
+
+
+def test_filter_map(nums):
+    out = (
+        take_rows(nums)
+        .filter(lambda r: int(r["n"]) % 2 == 0)
+        .map(lambda r: Row({"n2": str(int(r["n"]) * 2)}))
+        .to_rows()
+    )
+    assert out == rows_of(*[{"n2": str(2 * i)} for i in range(0, 10, 2)])
+
+
+def test_transform_drops_empty(nums):
+    """Transform passes non-empty results only (csvplus.go:265)."""
+
+    def tr(row):
+        if row["mod"] == "0":
+            return None  # drop
+        return Row({"n": row["n"]})
+
+    out = take_rows(nums).transform(tr).to_rows()
+    assert [r["n"] for r in out] == [str(i) for i in range(10) if i % 3 != 0]
+
+
+def test_transform_error_stops(nums):
+    def tr(row):
+        if row["n"] == "5":
+            raise ValueError("bad row")
+        return row
+
+    with pytest.raises(DataSourceError) as e:
+        take_rows(nums).transform(tr).to_rows()
+    assert e.value.line == 5
+
+
+def test_validate(nums):
+    def vf(row):
+        if row["n"] == "7":
+            raise ValueError("validation failed")
+
+    with pytest.raises(DataSourceError):
+        take_rows(nums).validate(vf).to_rows()
+    # all-pass case
+    assert len(take_rows(nums).validate(lambda r: None).to_rows()) == 10
+
+
+def test_top(nums):
+    assert [r["n"] for r in take_rows(nums).top(3).to_rows()] == ["0", "1", "2"]
+    assert take_rows(nums).top(0).to_rows() == []
+    assert len(take_rows(nums).top(100).to_rows()) == 10
+
+
+def test_top_stops_upstream_cleanly(people_csv):
+    """Top's stop is treated as clean end by the file reader
+    (csvplus.go:319 + 1141-1145)."""
+    out = Take(from_file(people_csv)).top(5).to_rows()
+    assert len(out) == 5
+
+
+def test_drop(nums):
+    assert [r["n"] for r in take_rows(nums).drop(7).to_rows()] == ["7", "8", "9"]
+    assert take_rows(nums).drop(100).to_rows() == []
+    assert len(take_rows(nums).drop(0).to_rows()) == 10
+
+
+def test_take_while(nums):
+    out = take_rows(nums).take_while(lambda r: r["n"] < "5").to_rows()
+    assert [r["n"] for r in out] == ["0", "1", "2", "3", "4"]
+    # once false, stays stopped even if pred would become true again
+    out = take_rows(nums).take_while(lambda r: r["mod"] == "0").to_rows()
+    assert [r["n"] for r in out] == ["0"]
+
+
+def test_drop_while(nums):
+    out = take_rows(nums).drop_while(lambda r: r["n"] < "5").to_rows()
+    assert [r["n"] for r in out] == ["5", "6", "7", "8", "9"]
+    # once yielding, never drops again
+    out = take_rows(nums).drop_while(lambda r: r["mod"] == "0").to_rows()
+    assert [r["n"] for r in out] == [str(i) for i in range(1, 10)]
+
+
+def test_drop_columns(nums):
+    out = take_rows(nums).drop_columns("mod").to_rows()
+    assert out == rows_of(*[{"n": str(i)} for i in range(10)])
+    with pytest.raises(ValueError):
+        take_rows(nums).drop_columns()
+
+
+def test_select_columns(nums):
+    out = take_rows(nums).select_columns("n").to_rows()
+    assert out == rows_of(*[{"n": str(i)} for i in range(10)])
+    with pytest.raises(ValueError):
+        take_rows(nums).select_columns()
+    with pytest.raises(DataSourceError):
+        take_rows(nums).select_columns("n", "xxx").to_rows()
+
+
+def test_predicates(nums):
+    even = lambda r: int(r["n"]) % 2 == 0
+    assert [r["n"] for r in take_rows(nums).filter(All(even, Like({"mod": "0"}))).to_rows()] == ["0", "6"]
+    assert [r["n"] for r in take_rows(nums).filter(Any(Like({"n": "1"}), Like({"n": "8"}))).to_rows()] == ["1", "8"]
+    assert len(take_rows(nums).filter(Not(even)).to_rows()) == 5
+    # Like on missing column is false
+    assert take_rows(nums).filter(Like({"zzz": "1"})).to_rows() == []
+    with pytest.raises(ValueError):
+        Like({})
+    # operator sugar
+    assert [r["n"] for r in take_rows(nums).filter(Like({"mod": "0"}) & Like({"n": "3"})).to_rows()] == ["3"]
+
+
+def test_python_iteration(nums):
+    """DataSource is iterable pythonically (streaming adapter)."""
+    assert [r["n"] for r in take_rows(nums)] == [str(i) for i in range(10)]
+    # partial consumption does not leak or deadlock
+    it = iter(take_rows(nums))
+    assert next(it)["n"] == "0"
+    assert next(it)["n"] == "1"
+    del it
+
+
+def test_long_chain(people_csv):
+    """Abbreviated analogue of TestLongChain (csvplus_test.go:248-366)."""
+    src = (
+        Take(from_file(people_csv).select_columns("id", "name", "surname"))
+        .filter(Not(Like({"name": "Jack"})))
+        .map(lambda r: r)
+        .drop(2)
+        .top(50)
+        .select_columns("name", "id")
+    )
+    out = src.to_rows()
+    assert len(out) == 50
+    assert all(set(r.keys()) == {"name", "id"} for r in out)
+    assert all(r["name"] != "Jack" for r in out)
+    # chain is lazy & re-runnable
+    assert src.to_rows() == out
