@@ -1,7 +1,202 @@
-"""Device plan executor (placeholder until M2 lands this round)."""
+"""The device plan executor.
+
+Walks a plan IR chain (:mod:`csvplus_tpu.plan`) rooted at a ``Scan`` of a
+:class:`~csvplus_tpu.columnar.table.DeviceTable` and executes it with
+columnar device kernels:
+
+* ``Filter`` -> fused boolean mask on the VPU (:mod:`..ops.filter`);
+* ``Top``/``DropRows`` -> selection-vector slicing (these are *ordered*
+  operators, so they act on the current selection, preserving the host
+  path's stream semantics, csvplus.go:313-342);
+* ``SelectCols``/``DropCols``/``MapExpr`` -> column-metadata updates
+  (a rename or constant write never touches row data);
+* ``Join``/``Except`` -> packed-key probe kernels (:mod:`..ops.join`).
+
+Execution keeps a **selection vector** (host int64 row ids) over
+full-length device columns and materializes gathers as late as possible;
+the only per-row host work is the final string decode at the sink
+boundary.
+
+Anything not expressible returns ``None`` from :func:`try_execute_plan`,
+and the caller falls back to the host streaming path — behavior parity
+always wins over device execution.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import plan as P
+from ..errors import DataSourceError, StopPipeline
+from ..row import MissingColumnError, Row
+from .table import DeviceTable, StringColumn
 
 
-def try_execute_plan(plan):
-    # No device tables exist yet, so no plan can be device-executable;
-    # sinks fall back to the host path on None.
-    return None
+class UnsupportedPlan(Exception):
+    """Plan contains a stage the device executor cannot lower."""
+
+
+class _View:
+    """Full-length columns + an ordered selection vector of row ids."""
+
+    __slots__ = ("cols", "sel", "device")
+
+    def __init__(self, cols: Dict[str, StringColumn], sel: np.ndarray, device):
+        self.cols = cols
+        self.sel = sel
+        self.device = device
+
+    def materialize(self) -> DeviceTable:
+        gathered = {n: c.gather(self.sel) for n, c in self.cols.items()}
+        return DeviceTable(gathered, int(self.sel.shape[0]), self.device)
+
+
+def _linearize(node: P.PlanNode) -> List[P.PlanNode]:
+    chain: List[P.PlanNode] = []
+    while not isinstance(node, P.Scan):
+        chain.append(node)
+        node = node.child
+    chain.append(node)
+    chain.reverse()
+    return chain
+
+
+def execute_plan(root: P.PlanNode) -> DeviceTable:
+    """Run the plan and return the resulting materialized DeviceTable."""
+    from ..ops.filter import UnsupportedPredicate, build_mask
+    from ..ops import join as J
+
+    stages = _linearize(root)
+    scan = stages[0]
+    assert isinstance(scan, P.Scan)
+    table: DeviceTable = scan.table
+    view = _View(
+        dict(table.columns), np.arange(table.nrows, dtype=np.int64), table.device
+    )
+
+    for node in stages[1:]:
+        if isinstance(node, P.Filter):
+            nrows = _full_len(view)
+            try:
+                mask = build_mask(view.cols, nrows, node.pred)
+            except UnsupportedPredicate as e:
+                raise UnsupportedPlan(str(e)) from e
+            mask_np = np.asarray(mask)
+            view.sel = view.sel[mask_np[view.sel]]
+        elif isinstance(node, P.Top):
+            view.sel = view.sel[: node.n]
+        elif isinstance(node, P.DropRows):
+            view.sel = view.sel[node.n :]
+        elif isinstance(node, P.SelectCols):
+            missing = [c for c in node.columns if c not in view.cols]
+            if missing:
+                # the host path fails at the first streamed row; use the
+                # 0-based position like the slice iterator (csvplus.go:242)
+                raise DataSourceError(0, MissingColumnError(missing[0]))
+            view.cols = {c: view.cols[c] for c in node.columns}
+        elif isinstance(node, P.DropCols):
+            view.cols = {
+                n: c for n, c in view.cols.items() if n not in set(node.columns)
+            }
+        elif isinstance(node, P.MapExpr):
+            _apply_map(view, node.expr)
+        elif isinstance(node, P.Join):
+            dev_index = node.index.device_table
+            if dev_index is None or not dev_index.supported:
+                raise UnsupportedPlan("join build side has no packed device index")
+            stream = view.materialize()
+            try:
+                joined = J.join_tables(stream, dev_index, list(node.columns))
+            except MissingColumnError as e:
+                raise DataSourceError(0, e) from e
+            view = _View(
+                dict(joined.columns),
+                np.arange(joined.nrows, dtype=np.int64),
+                joined.device,
+            )
+        elif isinstance(node, P.Except):
+            dev_index = node.index.device_table
+            if dev_index is None or not dev_index.supported:
+                raise UnsupportedPlan("except build side has no packed device index")
+            stream = view.materialize()
+            try:
+                keep = J.except_mask(stream, dev_index, list(node.columns))
+            except MissingColumnError as e:
+                raise DataSourceError(0, e) from e
+            view = _View(
+                dict(stream.columns),
+                np.flatnonzero(keep).astype(np.int64),
+                stream.device,
+            )
+        else:
+            raise UnsupportedPlan(f"no device lowering for {type(node).__name__}")
+
+    return view.materialize()
+
+
+def _full_len(view: _View) -> int:
+    for c in view.cols.values():
+        return len(c)
+    return 0
+
+
+def _apply_map(view: _View, expr) -> None:
+    from ..exprs import Rename, SetValue, Update
+
+    if isinstance(expr, Update):
+        for e in expr.exprs:
+            _apply_map(view, e)
+        return
+    if isinstance(expr, SetValue):
+        n = _full_len(view)
+        view.cols[expr.column] = StringColumn.constant(expr.value, n, view.device)
+        return
+    if isinstance(expr, Rename):
+        # sequential pop/overwrite, matching the host expr exactly
+        # (exprs.Rename: row[new] = row.pop(old) per mapping entry, so a
+        # rename onto an existing name overwrites it, and chained renames
+        # {'a':'b','b':'c'} cascade)
+        for old, new in expr.mapping.items():
+            if old in view.cols:
+                view.cols[new] = view.cols.pop(old)
+        return
+    raise UnsupportedPlan(f"cannot lower map expression {expr!r} to device")
+
+
+def try_execute_plan(root: Optional[P.PlanNode]) -> Optional[List[Row]]:
+    """Execute the plan to host Rows, or None when not device-executable."""
+    if root is None:
+        return None
+    try:
+        return execute_plan(root).to_rows()
+    except UnsupportedPlan:
+        return None
+
+
+def plan_runner(root: P.PlanNode, fallback=None):
+    """A DataSource driver that executes *root* on device and streams the
+    decoded rows; falls back to *fallback* when the plan is unsupported."""
+
+    def run(fn) -> None:
+        try:
+            table = execute_plan(root)
+        except UnsupportedPlan:
+            if fallback is None:
+                raise
+            fallback(fn)
+            return
+        rows = table.to_rows()
+        i = 0
+        try:
+            for i, row in enumerate(rows):
+                fn(row)
+        except StopPipeline:
+            return
+        except DataSourceError:
+            raise
+        except Exception as e:
+            raise DataSourceError(i, e) from e
+
+    return run

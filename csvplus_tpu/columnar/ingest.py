@@ -1,15 +1,65 @@
-"""CSV -> DeviceTable ingestion (placeholder until M2 lands this round)."""
+"""CSV / Index -> DeviceTable ingestion.
+
+``FromFile(...).OnDevice("tpu")`` — the north-star entry point from
+BASELINE.json — parses the CSV with the Reader's exact header and
+field-count policies (reference csvplus.go:1078-1146), columnarizes the
+fields without ever building per-row dicts, dictionary-encodes each
+column, and uploads the code arrays to HBM.  The returned DataSource
+carries a ``Scan`` plan, so downstream symbolic combinators extend the
+device plan; opaque callbacks transparently fall back to streaming decoded
+rows (full API parity).
+
+When the native C++ chunk scanner is available
+(:mod:`csvplus_tpu.native`), large simple-CSV files bypass the Python
+record parser entirely.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..source import DataSource
+from .table import DeviceTable
 
 
-def reader_to_device(reader, device="tpu", **opts):
-    raise NotImplementedError(
-        "OnDevice(): the columnar device executor is not built yet in this "
-        "checkout; use the host path (Take(reader)) meanwhile"
-    )
+def source_from_table(table: DeviceTable) -> DataSource:
+    """Plan-capable DataSource over an existing DeviceTable."""
+    from .exec import plan_runner
+    from ..plan import Scan
+
+    plan = Scan(table)
+    return DataSource(plan_runner(plan), plan=plan)
 
 
-def index_to_device(index, device="tpu"):
-    raise NotImplementedError(
-        "Index.on_device(): the columnar device executor is not built yet "
-        "in this checkout"
-    )
+def reader_to_device(reader, device: str = "tpu", **opts) -> DataSource:
+    """Parse *reader*'s CSV into a DeviceTable and wrap it as a source."""
+    names, data = _read_columns_fast(reader, **opts)
+    table = DeviceTable.from_pylists({n: data[n] for n in names}, device=device)
+    return source_from_table(table)
+
+
+def _read_columns_fast(reader, **opts):
+    """Columnar read — native C++ scanner when possible, Python fallback."""
+    path = getattr(reader, "_path", None)
+    if path is not None:
+        try:
+            from ..native import scanner
+
+            cols = scanner.read_columns_native(reader, path)
+            if cols is not None:
+                return cols
+        except ImportError:
+            pass
+    return reader.read_columns()
+
+
+def index_to_device(index, device: str = "tpu"):
+    """Columnarize an Index (sorted rows + key columns) for device joins.
+
+    Returns a :class:`csvplus_tpu.ops.join.DeviceIndex` carrying the
+    columnar table plus packed sorted keys.
+    """
+    from ..ops.join import DeviceIndex
+
+    table = DeviceTable.from_rows(index._impl.rows, device=device)
+    return DeviceIndex.build(table, index._impl.columns)

@@ -1,0 +1,242 @@
+"""HBM-resident columnar tables.
+
+The reference's execution model is row dicts of strings (csvplus.go:59).
+A TPU cannot chase per-row hash maps, so the device representation is
+columnar and **dictionary-encoded**: each string column becomes
+
+* ``dictionary`` — the column's unique values, sorted byte-
+  lexicographically (host numpy array; UTF-8 byte order == code-point
+  order, so this matches Go's ``strings.Compare`` sort semantics,
+  csvplus.go:798);
+* ``codes`` — ``int32[n]`` device array mapping row -> dictionary slot.
+  Because the dictionary is sorted, code order == string order, so
+  sorts, range searches and equality tests all run on the MXU/VPU as
+  integer ops.  Code ``-1`` marks an absent cell (rows in an Index may
+  have heterogeneous schemas after Transform stages).
+
+Predicates, joins and sorts run entirely over the code arrays on device;
+strings are only materialized back on the host at the sink boundary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..row import Row
+
+ABSENT = np.int32(-1)
+
+
+def default_device(device: Optional[str] = None):
+    """Resolve a device spec ("tpu", "cpu", None=default) to a jax.Device."""
+    if device is None or isinstance(device, str) and device == "default":
+        return jax.devices()[0]
+    if isinstance(device, str):
+        try:
+            return jax.devices(device)[0]
+        except RuntimeError:
+            # requested backend not present (e.g. "tpu" in a CPU test run):
+            # fall back to the default device so pipelines still work
+            return jax.devices()[0]
+    return device  # already a jax.Device
+
+
+def encode_strings(values: Sequence[str]) -> "tuple[np.ndarray, np.ndarray]":
+    """Dictionary-encode a string column: (sorted unique values, int32 codes).
+
+    ``None`` entries (absent cells) encode as code -1 and do not enter the
+    dictionary.
+    """
+    arr = np.asarray(values, dtype=object)
+    present = np.array([v is not None for v in arr], dtype=bool)
+    if present.all():
+        dictionary, codes = np.unique(np.asarray(values, dtype=np.str_), return_inverse=True)
+        return dictionary, codes.astype(np.int32)
+    codes = np.full(len(arr), ABSENT, dtype=np.int32)
+    if present.any():
+        present_vals = np.asarray([v for v in arr if v is not None], dtype=np.str_)
+        dictionary, inv = np.unique(present_vals, return_inverse=True)
+        codes[present] = inv.astype(np.int32)
+    else:
+        dictionary = np.empty(0, dtype=np.str_)
+    return dictionary, codes
+
+
+def lookup_code(dictionary: np.ndarray, value: str) -> int:
+    """Dictionary slot of *value*, or -1 when absent (host binary search)."""
+    if dictionary.size == 0:
+        return -1
+    i = int(np.searchsorted(dictionary, value))
+    if i < dictionary.size and dictionary[i] == value:
+        return i
+    return -1
+
+
+@dataclass
+class StringColumn:
+    """One dictionary-encoded string column."""
+
+    dictionary: np.ndarray  # sorted unique values, host
+    codes: jax.Array  # int32[n] on device; -1 = absent cell
+
+    @classmethod
+    def from_values(cls, values: Sequence[str], device) -> "StringColumn":
+        dictionary, codes = encode_strings(values)
+        return cls(dictionary, jax.device_put(codes, device))
+
+    @classmethod
+    def constant(cls, value: str, n: int, device) -> "StringColumn":
+        return cls(
+            np.asarray([value], dtype=np.str_),
+            jax.device_put(np.zeros(n, dtype=np.int32), device),
+        )
+
+    def __len__(self) -> int:
+        return int(self.codes.shape[0])
+
+    def gather(self, sel) -> "StringColumn":
+        """New column of the selected row positions (device gather)."""
+        idx = jnp.asarray(sel, dtype=jnp.int32)
+        return StringColumn(self.dictionary, jnp.take(self.codes, idx, axis=0))
+
+    def decode(self) -> List[Optional[str]]:
+        """Materialize values on host; absent cells become None."""
+        codes = np.asarray(self.codes)
+        if self.dictionary.size == 0:
+            return [None] * codes.shape[0]
+        vals = self.dictionary[np.clip(codes, 0, self.dictionary.size - 1)]
+        out = vals.tolist()
+        if (codes < 0).any():
+            out = [None if c < 0 else v for c, v in zip(codes.tolist(), out)]
+        return out
+
+    def renumbered_to(self, other_dictionary: np.ndarray) -> jax.Array:
+        """Translate this column's codes into another dictionary's code
+        space (host translation table + device gather); unmatched -> -1.
+
+        This is how a probe-side join key enters the index's key space.
+        """
+        if self.dictionary.size == 0:
+            return self.codes
+        pos = np.searchsorted(other_dictionary, self.dictionary)
+        pos = np.clip(pos, 0, max(other_dictionary.size - 1, 0))
+        ok = (
+            other_dictionary[pos] == self.dictionary
+            if other_dictionary.size
+            else np.zeros(self.dictionary.size, dtype=bool)
+        )
+        trans = np.where(ok, pos, -1).astype(np.int32)
+        trans_dev = jax.device_put(trans, None)
+        # absent stays absent; unmatched becomes -1
+        return jnp.where(
+            self.codes >= 0,
+            jnp.take(jnp.asarray(trans_dev), jnp.clip(self.codes, 0), axis=0),
+            ABSENT,
+        )
+
+
+def merge_with_fallback(primary: StringColumn, fallback: StringColumn) -> StringColumn:
+    """Cell-wise merge: primary's value where present, else fallback's.
+
+    This is the columnar form of the reference's row merge on column-name
+    collision (csvplus.go:571-583): the stream (primary) value wins, but a
+    stream row *without* the cell keeps the index (fallback) value.
+    Both columns are recoded into the union dictionary first.
+    """
+    p_codes = np.asarray(primary.codes)
+    if not (p_codes < 0).any():
+        return primary
+    union = np.union1d(primary.dictionary, fallback.dictionary)
+    p = primary.renumbered_to(union)
+    f = fallback.renumbered_to(union)
+    return StringColumn(union, jnp.where(p >= 0, p, f))
+
+
+class DeviceTable:
+    """An ordered set of equal-length columns resident on one device."""
+
+    def __init__(self, columns: Dict[str, StringColumn], nrows: int, device):
+        self.columns = columns
+        self.nrows = nrows
+        self.device = device
+
+    @classmethod
+    def from_pylists(
+        cls, data: Dict[str, Sequence[str]], device=None
+    ) -> "DeviceTable":
+        dev = default_device(device)
+        cols = {}
+        nrows = 0
+        for name, values in data.items():
+            cols[name] = StringColumn.from_values(values, dev)
+            nrows = len(values)
+        return cls(cols, nrows, dev)
+
+    @classmethod
+    def from_rows(cls, rows: Sequence[Row], device=None) -> "DeviceTable":
+        """Columnarize possibly-heterogeneous rows; missing cells -> absent."""
+        names: List[str] = []
+        seen = set()
+        for r in rows:
+            for k in r:
+                if k not in seen:
+                    seen.add(k)
+                    names.append(k)
+        data = {n: [r.get(n) for r in rows] for n in names}
+        t = cls.from_pylists(data, device)
+        t.nrows = len(rows)
+        return t
+
+    def column_names(self) -> List[str]:
+        return list(self.columns)
+
+    def short_desc(self) -> str:
+        return f"{self.nrows}x{len(self.columns)}[{','.join(self.columns)}]"
+
+    def gather(self, sel) -> "DeviceTable":
+        cols = {n: c.gather(sel) for n, c in self.columns.items()}
+        return DeviceTable(cols, int(len(sel)), self.device)
+
+    def to_rows(self, sel=None) -> List[Row]:
+        """Decode (a selection of) the table back into host Rows; absent
+        cells are omitted from their row, matching the host path's
+        heterogeneous dicts."""
+        cols = self.columns
+        if sel is not None:
+            cols = {n: c.gather(sel) for n, c in cols.items()}
+            n = int(len(sel))
+        else:
+            n = self.nrows
+        decoded = {name: c.decode() for name, c in cols.items()}
+        names = list(decoded)
+        out = []
+        for i in range(n):
+            row = Row()
+            for name in names:
+                v = decoded[name][i]
+                if v is not None:
+                    row[name] = v
+            out.append(row)
+        return out
+
+    # -- iteration protocol so take(DeviceTable) works ---------------------
+
+    def iterate(self, fn) -> None:
+        """Stream decoded rows (the escape hatch for opaque callbacks)."""
+        from ..source import iterate
+
+        iterate(self.to_rows(), fn)
+
+    Iterate = iterate
+
+    @property
+    def plan(self):
+        from ..plan import Scan
+
+        return Scan(self)

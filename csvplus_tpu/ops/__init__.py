@@ -1,0 +1,1 @@
+"""Device kernels: vectorized predicates, joins, sorts, dedup."""

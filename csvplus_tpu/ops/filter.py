@@ -1,0 +1,58 @@
+"""Vectorized row predicates over columnar data.
+
+The reference's ``Filter`` takes an opaque ``func(Row) bool``
+(csvplus.go:276-286) and its predicate DSL builds opaque closures
+(csvplus.go:1240-1293).  Here the same DSL objects (:mod:`..predicates`)
+are *lowered*: a ``Like`` becomes integer equality against dictionary
+codes, ``All``/``Any``/``Not`` become fused boolean algebra on the VPU —
+one pass over ``int32`` codes per referenced column, no host callback per
+row.
+
+Missing-column semantics match the host path exactly: ``Like`` on a row
+without the column is false (csvplus.go:1284-1292), so ``Not(Like(...))``
+over a missing column is true for every row.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from ..predicates import All, Any_, Like, Not
+from .. import predicates
+from ..columnar.table import StringColumn, lookup_code
+
+
+class UnsupportedPredicate(Exception):
+    """Raised when a predicate cannot be lowered (opaque Python callable)."""
+
+
+def build_mask(cols: Dict[str, StringColumn], nrows: int, pred) -> jnp.ndarray:
+    """Lower *pred* to a device boolean mask over all *nrows* rows."""
+    if isinstance(pred, Like):
+        mask = None
+        for col, val in pred.match.items():
+            if col not in cols:
+                return jnp.zeros(nrows, dtype=bool)
+            c = cols[col]
+            code = lookup_code(c.dictionary, val)
+            if code < 0:
+                return jnp.zeros(nrows, dtype=bool)
+            m = c.codes == code
+            mask = m if mask is None else (mask & m)
+        assert mask is not None  # Like() rejects empty match rows
+        return mask
+    if isinstance(pred, All):
+        mask = jnp.ones(nrows, dtype=bool)
+        for p in pred.preds:
+            mask = mask & build_mask(cols, nrows, p)
+        return mask
+    if isinstance(pred, Any_):
+        mask = jnp.zeros(nrows, dtype=bool)
+        for p in pred.preds:
+            mask = mask | build_mask(cols, nrows, p)
+        return mask
+    if isinstance(pred, Not):
+        return ~build_mask(cols, nrows, pred.pred)
+    raise UnsupportedPredicate(f"cannot lower predicate {pred!r} to device")

@@ -1,0 +1,232 @@
+"""Device lookup join: sorted packed keys + vectorized binary-search probe.
+
+The reference's join is a per-row binary search over sorted string rows
+(csvplus.go:552-568, 869-920).  The device design replaces it wholesale:
+
+* the build side (an :class:`~csvplus_tpu.index.Index`) is columnarized
+  and its key columns **packed into one integer per row** — each key
+  column's dictionary codes occupy a bit field sized to its cardinality.
+  Because each dictionary is sorted, the packed integer order equals the
+  reference's multi-column lexicographic string order, and because index
+  rows are already key-sorted, the packed array is sorted too;
+* the probe side translates its key columns into the build side's
+  dictionary spaces (host translation tables built by binary search over
+  the dictionaries, then one device gather), packs the same way, and a
+  single vectorized ``searchsorted`` finds every row's match range at
+  once — one fused device pass instead of ``n`` host binary searches;
+* match fan-out (non-unique indices) is data-dependent, so expansion is
+  two-phase: counts are computed on device, the total synced to host,
+  and the gather index vectors built with numpy before the final device
+  gathers — the count -> prefix-sum -> scatter pattern from SURVEY.md §7.
+
+Key-width tiers (TPUs are 32-bit-native; JAX int64 needs global x64):
+
+* <= 31 bits packed — ``int32`` keys, probe fully on device (covers the
+  benchmark configs: single join column up to ~1B cardinality, or e.g.
+  two columns of 32K x 32K);
+* <= 62 bits — keys packed on host in numpy ``int64``; the dictionary
+  translation gather still runs on device, the binary search runs in
+  numpy's C loop (documented hybrid);
+* wider — not packable; the planner falls back to the host join.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.table import DeviceTable, StringColumn
+
+
+def _bits_for(n: int) -> int:
+    """Bits needed to store codes 0..n-1 plus the sentinel 0 slot."""
+    return max(int(n + 1).bit_length(), 1)
+
+
+@jax.jit
+def _probe_kernel_i32(
+    keys: jax.Array, qk: jax.Array, range_size: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Vectorized range probe on device (int32 packed keys).
+
+    *range_size* widens the probe to a key-prefix range: 1 for full-width
+    keys, ``1 << shift_of_last_probed_column`` for prefix probes (the
+    reference's prefix ``find``, csvplus.go:870-891, and prefix joins).
+    """
+    lower = jnp.searchsorted(keys, qk, side="left")
+    upper = jnp.searchsorted(keys, qk + range_size, side="left")
+    valid = qk >= 0
+    counts = jnp.where(valid, upper - lower, 0)
+    return lower.astype(jnp.int32), counts.astype(jnp.int32)
+
+
+@dataclass
+class DeviceIndex:
+    """Columnar build side of a join: table + packed sorted keys."""
+
+    table: DeviceTable
+    key_columns: List[str]
+    packed_i32: Optional[jax.Array]  # int32[n] sorted, device (narrow keys)
+    packed_i64: Optional[np.ndarray]  # int64[n] sorted, host (wide keys)
+    shifts: Optional[List[int]]  # bit offset per key column
+
+    @classmethod
+    def build(cls, table: DeviceTable, key_columns: Sequence[str]) -> "DeviceIndex":
+        key_columns = list(key_columns)
+        cols = [table.columns[c] for c in key_columns]
+        bits = [_bits_for(c.dictionary.size) for c in cols]
+        total = sum(bits)
+        if total > 62:
+            return cls(table, key_columns, None, None, None)
+
+        shifts: List[int] = []
+        acc = 0
+        for b in reversed(bits):
+            shifts.insert(0, acc)
+            acc += b
+
+        if total <= 31:
+            key = jnp.zeros(table.nrows, dtype=jnp.int32)
+            for c, s in zip(cols, shifts):
+                key = key | (c.codes.astype(jnp.int32) << s)
+            return cls(table, key_columns, key, None, shifts)
+
+        key64 = np.zeros(table.nrows, dtype=np.int64)
+        for c, s in zip(cols, shifts):
+            key64 |= np.asarray(c.codes).astype(np.int64) << s
+        return cls(table, key_columns, None, key64, shifts)
+
+    @property
+    def supported(self) -> bool:
+        return self.shifts is not None
+
+    def _translated(self, probe_cols: List[StringColumn], n_key_cols: int):
+        """Per-column probe codes translated into the build dictionaries."""
+        out = []
+        for pc, ic_name in zip(probe_cols, self.key_columns[:n_key_cols]):
+            out.append(pc.renumbered_to(self.table.columns[ic_name].dictionary))
+        return out
+
+    def probe(
+        self, probe_cols: List[StringColumn], nrows: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """(lower, counts) per probe row, as host arrays.
+
+        Fewer probe columns than key columns = a prefix probe matching the
+        whole key range under the prefix.
+        """
+        assert self.supported
+        k = len(probe_cols)
+        codes = self._translated(probe_cols, k)
+        range_shift = self.shifts[k - 1] if k else 0
+
+        if self.packed_i32 is not None:
+            qk = jnp.zeros(nrows, dtype=jnp.int32)
+            ok = jnp.ones(nrows, dtype=bool)
+            for c, s in zip(codes, self.shifts):
+                ok = ok & (c >= 0)
+                qk = qk | (jnp.where(c >= 0, c, 0).astype(jnp.int32) << s)
+            qk = jnp.where(ok, qk, jnp.int32(-1))
+            lower, counts = _probe_kernel_i32(
+                self.packed_i32, qk, jnp.int32(1) << range_shift
+            )
+            return np.asarray(lower), np.asarray(counts)
+
+        # wide keys: pack + search on host (numpy int64)
+        qk64 = np.zeros(nrows, dtype=np.int64)
+        ok = np.ones(nrows, dtype=bool)
+        for c, s in zip(codes, self.shifts):
+            cn = np.asarray(c).astype(np.int64)
+            ok &= cn >= 0
+            qk64 |= np.where(cn >= 0, cn, 0) << s
+        lower = np.searchsorted(self.packed_i64, qk64, side="left")
+        upper = np.searchsorted(
+            self.packed_i64, qk64 + (np.int64(1) << range_shift), side="left"
+        )
+        counts = np.where(ok, upper - lower, 0)
+        return lower.astype(np.int64), counts.astype(np.int64)
+
+
+def expand_matches(
+    lower: np.ndarray, counts: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Fan-out expansion: (probe row ids, build row ids) for every match.
+
+    count -> exclusive prefix sum -> per-match offsets; numpy on host
+    because the total is data-dependent (it was just synced anyway).
+    """
+    total = int(counts.sum())
+    probe_ids = np.repeat(np.arange(counts.shape[0], dtype=np.int64), counts)
+    starts = np.repeat(lower.astype(np.int64), counts)
+    # within-group offset: position among this probe row's matches
+    ends = np.cumsum(counts)
+    group_base = np.repeat(ends - counts, counts)
+    offsets = np.arange(total, dtype=np.int64) - group_base
+    build_ids = starts + offsets
+    return probe_ids, build_ids
+
+
+def _checked_probe_cols(
+    stream: DeviceTable, columns: Sequence[str]
+) -> List[StringColumn]:
+    """Resolve the stream's key columns, with host-parity errors.
+
+    The host path raises ``missing column`` — wrapped with the row number —
+    either when the column is absent from the whole stream or when an
+    individual (heterogeneous) row lacks the cell (csvplus.go:556,599 via
+    SelectValues).  Columnar absent cells are code -1.
+    """
+    from ..errors import DataSourceError
+    from ..row import MissingColumnError
+
+    out = []
+    for c in columns:
+        if c not in stream.columns:
+            raise MissingColumnError(c)
+        col = stream.columns[c]
+        codes = np.asarray(col.codes)
+        absent = np.flatnonzero(codes < 0)
+        if absent.size:
+            raise DataSourceError(int(absent[0]), MissingColumnError(c))
+        out.append(col)
+    return out
+
+
+def join_tables(
+    stream: DeviceTable, dev_index: "DeviceIndex", columns: Sequence[str]
+) -> DeviceTable:
+    """stream ⋈ index with the reference's merge semantics: result rows
+    carry all columns from both sides; on a name collision the stream
+    row's value wins, but only for cells the stream row actually has
+    (csvplus.go:560, 571-583); stream order preserved, matches emitted in
+    index-sorted order (csvplus.go:559)."""
+    from ..columnar.table import merge_with_fallback
+
+    probe_cols = _checked_probe_cols(stream, columns)
+    lower, counts = dev_index.probe(probe_cols, stream.nrows)
+    probe_ids, build_ids = expand_matches(lower, counts)
+
+    out_cols = {}
+    for name, col in dev_index.table.columns.items():
+        out_cols[name] = col.gather(build_ids)
+    for name, col in stream.columns.items():  # stream wins on collision...
+        g = col.gather(probe_ids)
+        if name in out_cols:
+            # ...but an absent stream cell keeps the index value
+            g = merge_with_fallback(g, out_cols[name])
+        out_cols[name] = g
+    return DeviceTable(out_cols, len(probe_ids), stream.device)
+
+
+def except_mask(
+    stream: DeviceTable, dev_index: "DeviceIndex", columns: Sequence[str]
+) -> np.ndarray:
+    """Boolean keep-mask for the anti-join (csvplus.go:585-608)."""
+    probe_cols = _checked_probe_cols(stream, columns)
+    _, counts = dev_index.probe(probe_cols, stream.nrows)
+    return counts == 0

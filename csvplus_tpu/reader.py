@@ -125,26 +125,7 @@ class Reader:
     def iterate(self, fn: RowFunc) -> None:
         stream, closer = self._open(line_no=1)
         try:
-            records = parse_records(
-                stream,
-                delimiter=self._delimiter,
-                comment=self._comment,
-                lazy_quotes=self._lazy_quotes,
-                trim_leading_space=self._trim_leading_space,
-            )
-            line_no = 1
-            expected_fields = self._num_fields
-
-            # header
-            if self._header_from_first_row:
-                first = self._read_record(records, line_no)
-                if first is None:
-                    raise DataSourceError(line_no, "EOF")
-                expected_fields = self._check_count(first, expected_fields, line_no)
-                header = self._make_header(first, line_no)
-                line_no += 1
-            else:
-                header = dict(self._header or {})
+            records, header, line_no, expected_fields = self._start(stream)
 
             # hot loop
             for rec in self._record_iter(records, line_no):
@@ -175,6 +156,34 @@ class Reader:
     Iterate = iterate
 
     # -- helpers -----------------------------------------------------------
+
+    def _start(self, stream):
+        """Shared iteration preamble: build the record parser and resolve
+        the header per the configured policy (csvplus.go:1090-1112).
+
+        Returns (records, header, next_line_no, expected_fields); both
+        :meth:`iterate` and :meth:`read_columns` go through here so the
+        streaming and columnar paths can never diverge on policy.
+        """
+        records = parse_records(
+            stream,
+            delimiter=self._delimiter,
+            comment=self._comment,
+            lazy_quotes=self._lazy_quotes,
+            trim_leading_space=self._trim_leading_space,
+        )
+        line_no = 1
+        expected_fields = self._num_fields
+        if self._header_from_first_row:
+            first = self._read_record(records, line_no)
+            if first is None:
+                raise DataSourceError(line_no, "EOF")
+            expected_fields = self._check_count(first, expected_fields, line_no)
+            header = self._make_header(first, line_no)
+            line_no += 1
+        else:
+            header = dict(self._header or {})
+        return records, header, line_no, expected_fields
 
     def _open(self, line_no: int):
         try:
@@ -245,6 +254,39 @@ class Reader:
             raise DataSourceError(line_no, "column not found: " + missing[0])
 
         return header
+
+    def read_columns(self):
+        """Parse the whole input into columns (name -> list of values),
+        applying the same header/field-count policies and raising the same
+        row-numbered errors as :meth:`iterate`.
+
+        This is the columnar ingest entry: no per-row dicts are built, so
+        it is the fast path feeding
+        :func:`csvplus_tpu.columnar.ingest.reader_to_device`.
+        """
+        stream, closer = self._open(line_no=1)
+        try:
+            records, header, line_no, expected_fields = self._start(stream)
+
+            names = list(header)
+            idxs = [header[n] for n in names]
+            data: Dict[str, List[str]] = {n: [] for n in names}
+            for rec in self._record_iter(records, line_no):
+                expected_fields = self._check_count(rec, expected_fields, line_no)
+                nrec = len(rec)
+                for n, ix in zip(names, idxs):
+                    if ix < nrec:
+                        data[n].append(rec[ix])
+                    elif self._num_fields < 0:  # padding allowed
+                        data[n].append("")
+                    else:
+                        raise DataSourceError(
+                            line_no, f'column not found: "{n}" ({ix})'
+                        )
+                line_no += 1
+            return names, data
+        finally:
+            closer()
 
     # -- device ingestion hook (M2) ----------------------------------------
 

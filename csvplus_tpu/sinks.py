@@ -6,9 +6,10 @@ atomic ``writeFile`` helper (csvplus.go:418-443): on any error — including
 an exception unwinding through the sink — the partially-written file is
 closed and removed, so sinks never leave partial outputs behind.
 
-When the source carries a device plan (see :mod:`csvplus_tpu.plan`), sinks
-execute the fused device pipeline and stream the result out; otherwise they
-drive the host row-at-a-time path.  Output bytes are identical either way.
+A device-planned source executes its fused plan inside the ``src(fn)``
+call itself (its driver is :func:`csvplus_tpu.columnar.exec.plan_runner`),
+so sinks are agnostic: output bytes and error wrapping are identical on
+both paths.
 """
 
 from __future__ import annotations
@@ -22,16 +23,6 @@ from .errors import StopPipeline
 from .row import Row
 
 
-def _device_rows(src) -> "List[Row] | None":
-    """If the chain is fully symbolic over a device table, execute it on
-    device and return the resulting rows; else None (host path)."""
-    if getattr(src, "plan", None) is None:
-        return None
-    from .columnar.exec import try_execute_plan
-
-    return try_execute_plan(src.plan)
-
-
 def to_csv(src, out: IO[str], *columns: str) -> None:
     """Write selected columns in canonical CSV form: header line first,
     fixed arity (csvplus.go:379-406)."""
@@ -39,12 +30,6 @@ def to_csv(src, out: IO[str], *columns: str) -> None:
         raise ValueError("empty column list in ToCsv() function")
 
     write_record(out, list(columns))
-
-    rows = _device_rows(src)
-    if rows is not None:
-        for row in rows:
-            write_record(out, row.select_values(*columns))
-        return
 
     def fn(row: Row) -> None:
         write_record(out, row.select_values(*columns))
@@ -86,12 +71,7 @@ def to_json(src, out: IO[str]) -> None:
             buf.clear()
             buf_len = 0
 
-    rows = _device_rows(src)
-    if rows is not None:
-        for row in rows:
-            emit(row)
-    else:
-        src(emit)
+    src(emit)
 
     buf.append("]")
     out.write("".join(buf))
@@ -104,11 +84,11 @@ def to_json_file(src, name: str) -> None:
 
 
 def to_rows(src) -> List[Row]:
-    """Materialize the source into a list of Rows (csvplus.go:483-490)."""
-    rows = _device_rows(src)
-    if rows is not None:
-        return rows
+    """Materialize the source into a list of Rows (csvplus.go:483-490).
 
+    A device-planned source executes its fused plan inside ``src(fn)``
+    (see :func:`csvplus_tpu.columnar.exec.plan_runner`), so sinks need no
+    device special-casing — and error wrapping is identical either way."""
     out: List[Row] = []
     src(out.append)
     return out

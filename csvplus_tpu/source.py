@@ -143,7 +143,7 @@ class DataSource:
             self._run(step)
 
         from .plan import transform_plan
-        return DataSource(run, plan=transform_plan(self.plan, trans))
+        return _make(run, transform_plan(self.plan, trans))
 
     def filter(self, pred: Callable[[Row], bool]) -> "DataSource":
         """Keep rows for which *pred* is true (csvplus.go:276-286)."""
@@ -156,7 +156,7 @@ class DataSource:
             self._run(step)
 
         from .plan import filter_plan
-        return DataSource(run, plan=filter_plan(self.plan, pred))
+        return _make(run, filter_plan(self.plan, pred))
 
     def map(self, mf: Callable[[Row], Row]) -> "DataSource":
         """Apply *mf* to every row (csvplus.go:290-296)."""
@@ -169,7 +169,7 @@ class DataSource:
             self._run(step)
 
         from .plan import map_plan
-        return DataSource(run, plan=map_plan(self.plan, mf))
+        return _make(run, map_plan(self.plan, mf))
 
     def validate(self, vf: Callable[[Row], None]) -> "DataSource":
         """Check every row; *vf* raises to fail the pipeline at that row
@@ -202,7 +202,7 @@ class DataSource:
             self._run(step)
 
         from .plan import top_plan
-        return DataSource(run, plan=top_plan(self.plan, n))
+        return _make(run, top_plan(self.plan, n))
 
     def drop(self, n: int) -> "DataSource":
         """Skip the first *n* rows (csvplus.go:329-342)."""
@@ -220,7 +220,7 @@ class DataSource:
             self._run(step)
 
         from .plan import drop_plan
-        return DataSource(run, plan=drop_plan(self.plan, n))
+        return _make(run, drop_plan(self.plan, n))
 
     def take_while(self, pred: Callable[[Row], bool]) -> "DataSource":
         """Pass rows until *pred* is first false, then stop (csvplus.go:346-358)."""
@@ -268,7 +268,7 @@ class DataSource:
             self._run(step)
 
         from .plan import drop_columns_plan
-        return DataSource(run, plan=drop_columns_plan(self.plan, columns))
+        return _make(run, drop_columns_plan(self.plan, columns))
 
     def select_columns(self, *columns: str) -> "DataSource":
         """Keep exactly the listed columns; error if any is missing
@@ -283,7 +283,7 @@ class DataSource:
             self._run(step)
 
         from .plan import select_columns_plan
-        return DataSource(run, plan=select_columns_plan(self.plan, columns))
+        return _make(run, select_columns_plan(self.plan, columns))
 
     # -- index / join entry points (implemented in index.py) ---------------
 
@@ -321,7 +321,7 @@ class DataSource:
             self._run(step)
 
         from .plan import join_plan
-        return DataSource(run, plan=join_plan(self.plan, index, cols))
+        return _make(run, join_plan(self.plan, index, cols))
 
     def except_(self, index, *columns: str) -> "DataSource":
         """Anti-join: pass through rows whose key is NOT in *index*
@@ -337,7 +337,7 @@ class DataSource:
             self._run(step)
 
         from .plan import except_plan
-        return DataSource(run, plan=except_plan(self.plan, index, cols))
+        return _make(run, except_plan(self.plan, index, cols))
 
     # -- sinks (implemented in sinks.py) -----------------------------------
 
@@ -386,6 +386,16 @@ class DataSource:
     ToJSON = to_json
     ToJSONFile = to_json_file
     ToRows = to_rows
+
+
+def _make(run, plan) -> "DataSource":
+    """Build a combinator result: device plan execution when the chain is
+    symbolic, with *run* (the host streaming closure) as fallback."""
+    if plan is None:
+        return DataSource(run)
+    from .columnar.exec import plan_runner
+
+    return DataSource(plan_runner(plan, fallback=run), plan=plan)
 
 
 def _resolve_join_columns(index, columns: Sequence[str], what: str) -> List[str]:

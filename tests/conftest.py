@@ -11,6 +11,53 @@ must be set before JAX initializes, hence at module import here.
 """
 
 import os
+import sys
+
+# The test suite must run on a virtual 8-device CPU mesh, hermetically:
+# this box presets JAX_PLATFORMS=axon (a tunneled single TPU chip) and a
+# sitecustomize that registers the axon PJRT plugin in EVERY interpreter,
+# which (a) leaves only 1 device, breaking sharding tests, and (b) makes
+# backend init depend on a network tunnel.  Env vars are only read at
+# interpreter start (sitecustomize) / backend init, so the reliable fix
+# is to re-exec pytest once with a scrubbed environment.
+# (sitecustomize imports jax in every interpreter on this box, but backend
+# init is lazy, so re-exec before any test touches a device is safe.  The
+# re-exec must happen AFTER pytest's fd-capture is stopped, or the child
+# inherits the capture temp file as stdout and runs silently — hence the
+# pytest_configure hook below rather than a module-level exec.)
+
+
+def _hermetic_env():
+    env = dict(os.environ)
+    env["CSVPLUS_TPU_HERMETIC"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize skips axon register
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env["XLA_FLAGS"] = flags
+    return env
+
+
+def pytest_configure(config):
+    if os.environ.get("CSVPLUS_TPU_HERMETIC") == "1":
+        return
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return  # no axon plugin in play; module-level defaults suffice
+    capman = config.pluginmanager.get_plugin("capturemanager")
+    if capman is not None:
+        try:
+            capman.stop_global_capturing()
+        except Exception:
+            pass
+    sys.stderr.write("[conftest] re-exec into hermetic CPU jax environment\n")
+    sys.stderr.flush()
+    os.execve(
+        sys.executable,
+        [sys.executable, "-m", "pytest"] + sys.argv[1:],
+        _hermetic_env(),
+    )
+
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")

@@ -1,0 +1,312 @@
+"""Differential tests: columnar device executor vs host streaming path.
+
+Every test computes the same pipeline both ways and requires identical
+results — the host path (exact reference parity) is the oracle, per
+SURVEY.md §7's design.  Runs on the CPU backend (conftest forces
+JAX_PLATFORMS=cpu with 8 virtual devices); the same code paths run on TPU.
+"""
+
+import io
+
+import pytest
+
+import csvplus_tpu as csvplus
+from csvplus_tpu import (
+    All,
+    Any,
+    DataSourceError,
+    Like,
+    Not,
+    Rename,
+    Row,
+    SetValue,
+    Take,
+    from_file,
+)
+
+
+@pytest.fixture()
+def host_people(people_csv):
+    return Take(from_file(people_csv))
+
+
+@pytest.fixture()
+def dev_people(people_csv):
+    return from_file(people_csv).on_device("cpu")
+
+
+def same(a, b):
+    assert a == b, f"device/host mismatch: {len(a)} vs {len(b)} rows"
+
+
+def test_ingest_parity(host_people, dev_people):
+    same(dev_people.to_rows(), host_people.to_rows())
+
+
+def test_plan_attached(dev_people):
+    assert dev_people.plan is not None
+    assert dev_people.filter(Like({"name": "Amelia"})).plan is not None
+    # opaque callback breaks the plan but not the behavior
+    assert dev_people.filter(lambda r: True).plan is None
+
+
+def test_filter_like_parity(host_people, dev_people):
+    p = Like({"name": "Amelia"})
+    same(dev_people.filter(p).to_rows(), host_people.filter(p).to_rows())
+
+
+def test_filter_combinators_parity(host_people, dev_people):
+    p = All(Like({"name": "Amelia"}), Not(Like({"surname": "Smith"})))
+    same(dev_people.filter(p).to_rows(), host_people.filter(p).to_rows())
+    q = Any(Like({"surname": "Jones"}), Like({"surname": "Lewis"}))
+    same(dev_people.filter(q).to_rows(), host_people.filter(q).to_rows())
+
+
+def test_filter_missing_column_false(host_people, dev_people):
+    p = Like({"nope": "x"})
+    same(dev_people.filter(p).to_rows(), host_people.filter(p).to_rows())
+    n = Not(Like({"nope": "x"}))
+    same(dev_people.filter(n).to_rows(), host_people.filter(n).to_rows())
+
+
+def test_select_drop_columns_parity(host_people, dev_people):
+    same(
+        dev_people.select_columns("id", "name").to_rows(),
+        host_people.select_columns("id", "name").to_rows(),
+    )
+    same(
+        dev_people.drop_columns("born").to_rows(),
+        host_people.drop_columns("born").to_rows(),
+    )
+
+
+def test_select_missing_column_errors(dev_people):
+    with pytest.raises(DataSourceError):
+        dev_people.select_columns("id", "zzz").to_rows()
+
+
+def test_windowing_parity(host_people, dev_people):
+    for stage in [
+        lambda s: s.top(7),
+        lambda s: s.drop(100),
+        lambda s: s.filter(Like({"name": "Jack"})).top(3),
+        lambda s: s.drop(5).top(5),
+        lambda s: s.top(0),
+    ]:
+        same(stage(dev_people).to_rows(), stage(host_people).to_rows())
+
+
+def test_map_setvalue_rename_parity(host_people, dev_people):
+    m = SetValue("name", "Julia")
+    same(dev_people.map(m).to_rows(), host_people.map(m).to_rows())
+    r = Rename({"born": "year"})
+    same(dev_people.map(r).to_rows(), host_people.map(r).to_rows())
+
+
+def test_opaque_fallback_correct(host_people, dev_people):
+    """An opaque Python callback mid-chain falls back transparently —
+    and still benefits from the device prefix."""
+    f = lambda row: int(row["born"]) % 2 == 0
+    same(
+        dev_people.filter(Like({"name": "Ava"})).filter(f).to_rows(),
+        host_people.filter(Like({"name": "Ava"})).filter(f).to_rows(),
+    )
+
+
+def test_config1_tocsv_byte_identical(host_people, people_csv, tmp_path):
+    """BASELINE config 1 on device: byte-identical CSV output."""
+    host_out, dev_out = str(tmp_path / "host.csv"), str(tmp_path / "dev.csv")
+    pipeline = lambda src: src.filter(Like({"name": "Amelia"})).map(
+        SetValue("name", "Julia")
+    ).to_csv_file
+    pipeline(Take(from_file(people_csv)))(host_out, "name", "surname")
+    pipeline(from_file(people_csv).on_device("cpu"))(dev_out, "name", "surname")
+    assert open(dev_out, "rb").read() == open(host_out, "rb").read()
+
+
+def test_json_parity(host_people, dev_people):
+    a, b = io.StringIO(), io.StringIO()
+    host_people.to_json(a)
+    dev_people.to_json(b)
+    assert a.getvalue() == b.getvalue()
+
+
+# -- device joins ---------------------------------------------------------
+
+
+@pytest.fixture()
+def orders_host(orders_csv):
+    return Take(from_file(orders_csv).select_columns("cust_id", "prod_id", "qty", "ts"))
+
+
+@pytest.fixture()
+def orders_dev(orders_csv):
+    return (
+        from_file(orders_csv)
+        .on_device("cpu")
+        .select_columns("cust_id", "prod_id", "qty", "ts")
+    )
+
+
+def test_join_parity(host_people, orders_host, orders_dev, people_csv):
+    cust = Take(
+        from_file(people_csv).select_columns("id", "name", "surname")
+    ).unique_index_on("id")
+    host_rows = orders_host.join(cust, "cust_id").to_rows()
+    cust.on_device("cpu")
+    dev_rows = orders_dev.join(cust, "cust_id").to_rows()
+    same(dev_rows, host_rows)
+
+
+def test_join_fanout_parity(people_csv, orders_host, orders_dev):
+    """Non-unique index fan-out: each stream row merges with every match,
+    in index-sorted order."""
+    name_idx = Take(
+        from_file(people_csv).select_columns("id", "name")
+    ).index_on("id")
+    # make it non-unique by indexing on a shared column
+    multi = Take(from_file(people_csv)).index_on("name")
+    host_rows = (
+        orders_host.top(50).map(SetValue("name", "Amelia")).join(multi, "name").to_rows()
+    )
+    multi.on_device("cpu")
+    dev_rows = (
+        orders_dev.top(50).map(SetValue("name", "Amelia")).join(multi, "name").to_rows()
+    )
+    same(dev_rows, host_rows)
+
+
+def test_three_way_join_parity(people_csv, stock_csv, orders_host, orders_dev):
+    """BASELINE config 3 (README's 3-table join) on device == host."""
+    cust = Take(
+        from_file(people_csv).select_columns("id", "name", "surname")
+    ).unique_index_on("id")
+    prod = Take(
+        from_file(stock_csv).select_columns("prod_id", "product", "price")
+    ).unique_index_on("prod_id")
+    host_rows = orders_host.join(cust, "cust_id").join(prod).to_rows()
+    cust.on_device("cpu")
+    prod.on_device("cpu")
+    dev_rows = orders_dev.join(cust, "cust_id").join(prod).to_rows()
+    same(dev_rows, host_rows)
+
+
+def test_except_parity(people_csv, orders_host, orders_dev):
+    some = Take(from_file(people_csv)).filter(Like({"name": "Amelia"})).index_on("id")
+    host_rows = orders_host.except_(some, "cust_id").to_rows()
+    some.on_device("cpu")
+    dev_rows = orders_dev.except_(some, "cust_id").to_rows()
+    same(dev_rows, host_rows)
+
+
+def test_join_unmatched_keys_dropped(people_csv):
+    """Stream keys absent from the index produce no output rows."""
+    idx = Take(from_file(people_csv).select_columns("id", "name")).unique_index_on("id")
+    idx.on_device("cpu")
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    stream = source_from_table(
+        DeviceTable.from_pylists({"id": ["0", "99999", "3"]}, device="cpu")
+    )
+    rows = stream.join(idx, "id").to_rows()
+    assert [r["id"] for r in rows] == ["0", "3"]
+
+
+def test_device_index_survives_dict_miss(people_csv):
+    """Probe values entirely absent from the build dictionary."""
+    idx = Take(from_file(people_csv).select_columns("id", "name")).unique_index_on("id")
+    idx.on_device("cpu")
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    stream = source_from_table(
+        DeviceTable.from_pylists({"id": ["zzz", "qqq"]}, device="cpu")
+    )
+    assert stream.join(idx, "id").to_rows() == []
+    assert [r["id"] for r in stream.except_(idx, "id").to_rows()] == ["zzz", "qqq"]
+
+
+def test_wide_key_hybrid_path():
+    """Two key columns whose packed width exceeds 31 bits exercise the
+    host-int64 hybrid probe tier."""
+    import random
+
+    from csvplus_tpu import TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    rng = random.Random(3)
+    n = 70_000
+    a = [f"a{i:06d}" for i in range(n)]
+    b = [f"b{rng.randrange(n):06d}" for _ in range(n)]
+    v = [str(i) for i in range(n)]
+    rows = [Row({"a": x, "b": y, "v": z}) for x, y, z in zip(a, b, v)]
+    idx = TakeRows(rows).index_on("a", "b")
+    idx.on_device("cpu")
+    assert idx.device_table.packed_i64 is not None  # wide tier engaged
+
+    probe = DeviceTable.from_pylists(
+        {"a": [a[0], a[1], "zzz"], "b": [b[0], "nope", b[2]]}, device="cpu"
+    )
+    got = source_from_table(probe).join(idx, "a", "b").to_rows()
+    want = (
+        TakeRows([Row({"a": a[0], "b": b[0]}), Row({"a": a[1], "b": "nope"}),
+                  Row({"a": "zzz", "b": b[2]})])
+        .join(idx, "a", "b")
+        .to_rows()
+    )
+    assert got == want and len(got) == 1
+
+
+def test_rename_collision_parity(host_people, dev_people):
+    """Rename onto an existing column overwrites it (review regression)."""
+    r = Rename({"name": "surname"})
+    same(dev_people.map(r).to_rows(), host_people.map(r).to_rows())
+    chained = Rename({"name": "born"})
+    same(dev_people.map(chained).to_rows(), host_people.map(chained).to_rows())
+
+
+def test_join_absent_key_cell_errors(people_csv):
+    """A heterogeneous stream row lacking the join-key cell errors like the
+    host path (review regression)."""
+    from csvplus_tpu import TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    idx = Take(from_file(people_csv).select_columns("id", "name")).unique_index_on("id")
+    idx.on_device("cpu")
+    rows = [Row({"id": "1", "v": "a"}), Row({"v": "b"})]
+    stream = source_from_table(DeviceTable.from_rows(rows, device="cpu"))
+    with pytest.raises(DataSourceError) as e:
+        stream.join(idx, "id").to_rows()
+    assert 'missing column "id"' in str(e.value)
+    with pytest.raises(DataSourceError):
+        stream.except_(idx, "id").to_rows()
+
+
+def test_join_absent_collision_keeps_index_value(people_csv):
+    """On column collision, an absent stream cell keeps the index value,
+    like the host dict merge (review regression)."""
+    from csvplus_tpu import TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    index_rows = [Row({"k": "a", "extra": "IDX"})]
+    idx = TakeRows(index_rows).index_on("k")
+    host = TakeRows([Row({"k": "a"}), Row({"k": "a", "extra": "S"})]).join(idx, "k").to_rows()
+    idx.on_device("cpu")
+    stream = source_from_table(
+        DeviceTable.from_rows([Row({"k": "a"}), Row({"k": "a", "extra": "S"})], device="cpu")
+    )
+    dev = stream.join(idx, "k").to_rows()
+    assert dev == host
+    assert dev[0]["extra"] == "IDX" and dev[1]["extra"] == "S"
+
+
+def test_device_select_missing_column_row_number(dev_people):
+    """Device SelectCols error carries the 0-based row number like the
+    slice iterator (review regression)."""
+    with pytest.raises(DataSourceError) as e:
+        dev_people.select_columns("id", "zzz").to_rows()
+    assert str(e.value) == 'row 0: missing column "zzz"'
