@@ -1,0 +1,164 @@
+"""Headline benchmark: 3-way lookup join throughput (BASELINE config 3/5).
+
+Workload: orders ⋈ customers(unique id) ⋈ products(unique prod_id) — the
+reference README's flagship pipeline (README.md:54-65), whose reference
+hot loop does 2 host binary searches + 2 map merges per row
+(csvplus.go:552-583, SURVEY.md §3.3).
+
+What is timed:
+
+* **device**: the fused flagship step (two vectorized binary-search
+  probes + validity mask) + attribute gathers + match compaction — i.e.
+  a materialized *columnar* join result resident on device.  String
+  decode to host dicts is sink cost, not join cost, and is excluded.
+* **baseline**: this framework's host executor (the comparable CPU
+  row-dict path per BASELINE.md: Go toolchain is not installed) running
+  the same join with dict merges, timed on a subsample and scaled.
+
+Output: ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs: CSVPLUS_BENCH_ROWS (default 2_000_000 orders),
+CSVPLUS_BENCH_CUSTOMERS (100_000), CSVPLUS_BENCH_PRODUCTS (1_000),
+CSVPLUS_BENCH_HOST_SAMPLE (200_000), CSVPLUS_BENCH_REPS (5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _gen_data(n_orders: int, n_cust: int, n_prod: int):
+    """Synthetic string-keyed tables, reference-shaped (csvplus_test.go
+    generators: random cust/prod ids, qty, price)."""
+    import numpy as np
+
+    rng = np.random.default_rng(20160914)
+    cust_ids = np.char.add("c", np.arange(n_cust).astype(np.str_))
+    prod_ids = np.char.add("p", np.arange(n_prod).astype(np.str_))
+    orders_cust = cust_ids[rng.integers(0, n_cust, n_orders)]
+    orders_prod = prod_ids[rng.integers(0, n_prod, n_orders)]
+    qty = rng.integers(1, 101, n_orders).astype(np.str_)
+    names = np.char.add("name", (np.arange(n_cust) % 9973).astype(np.str_))
+    prices = np.char.mod("%.2f", rng.uniform(0.01, 99.0, n_prod))
+    products = np.char.add("prod", (np.arange(n_prod)).astype(np.str_))
+    return {
+        "orders": {"cust_id": orders_cust, "prod_id": orders_prod, "qty": qty},
+        "customers": {"id": cust_ids, "name": names},
+        "products": {"prod_id": prod_ids, "product": products, "price": prices},
+    }
+
+
+def _bench_device(data, reps: int) -> float:
+    """Joined rows per second on the device (median over reps)."""
+    import jax
+    import numpy as np
+
+    from csvplus_tpu.columnar.table import DeviceTable
+    from csvplus_tpu.models.flagship import ThreewayJoin
+    from csvplus_tpu.ops.join import DeviceIndex
+    from csvplus_tpu.ops.sort import sort_table
+
+    dev = jax.devices()[0]
+
+    def table(d):
+        return DeviceTable.from_pylists(
+            {k: v.tolist() for k, v in d.items()}, device=dev
+        )
+
+    cust_t = sort_table(table(data["customers"]), ["id"])
+    prod_t = sort_table(table(data["products"]), ["prod_id"])
+    orders_t = table(data["orders"])
+    cust = DeviceIndex.build(cust_t, ["id"])
+    prod = DeviceIndex.build(prod_t, ["prod_id"])
+
+    tw = ThreewayJoin.build(orders_t, cust, prod)
+
+    def once():
+        t = tw.run()  # probe + gathers + compaction, columnar result
+        for c in t.columns.values():
+            c.codes.block_until_ready()
+        return t.nrows
+
+    nrows = once()  # warmup + compile
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        once()
+        times.append(time.perf_counter() - t0)
+    med = sorted(times)[len(times) // 2]
+    n_orders = len(next(iter(data["orders"].values())))
+    assert nrows == n_orders  # all keys hit by construction
+    return n_orders / med
+
+
+def _bench_host(data, sample: int) -> float:
+    """The host row-dict executor on a subsample; rows per second."""
+    from csvplus_tpu import Row, take_rows
+
+    orders_rows = [
+        Row({"cust_id": c, "prod_id": p, "qty": q})
+        for c, p, q in zip(
+            data["orders"]["cust_id"][:sample].tolist(),
+            data["orders"]["prod_id"][:sample].tolist(),
+            data["orders"]["qty"][:sample].tolist(),
+        )
+    ]
+    cust_rows = [
+        Row({"id": i, "name": n})
+        for i, n in zip(
+            data["customers"]["id"].tolist(), data["customers"]["name"].tolist()
+        )
+    ]
+    prod_rows = [
+        Row({"prod_id": i, "product": pr, "price": p})
+        for i, pr, p in zip(
+            data["products"]["prod_id"].tolist(),
+            data["products"]["product"].tolist(),
+            data["products"]["price"].tolist(),
+        )
+    ]
+    cust_idx = take_rows(cust_rows).unique_index_on("id")
+    prod_idx = take_rows(prod_rows).unique_index_on("prod_id")
+
+    src = take_rows(orders_rows).join(cust_idx, "cust_id").join(prod_idx)
+    count = 0
+
+    def sink(row):
+        nonlocal count
+        count += 1
+
+    t0 = time.perf_counter()
+    src(sink)
+    dt = time.perf_counter() - t0
+    assert count == len(orders_rows)
+    return count / dt
+
+
+def main() -> None:
+    n_orders = int(os.environ.get("CSVPLUS_BENCH_ROWS", 2_000_000))
+    n_cust = int(os.environ.get("CSVPLUS_BENCH_CUSTOMERS", 100_000))
+    n_prod = int(os.environ.get("CSVPLUS_BENCH_PRODUCTS", 1_000))
+    sample = int(os.environ.get("CSVPLUS_BENCH_HOST_SAMPLE", 200_000))
+    reps = int(os.environ.get("CSVPLUS_BENCH_REPS", 5))
+
+    data = _gen_data(n_orders, n_cust, n_prod)
+    device_rps = _bench_device(data, reps)
+    host_rps = _bench_host(data, min(sample, n_orders))
+
+    print(
+        json.dumps(
+            {
+                "metric": "threeway_join_rows_per_sec_chip",
+                "value": round(device_rps, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(device_rps / host_rps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

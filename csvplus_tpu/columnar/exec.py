@@ -39,14 +39,22 @@ class UnsupportedPlan(Exception):
 
 
 class _View:
-    """Full-length columns + an ordered selection vector of row ids."""
+    """Full-length columns + an ordered selection vector of row ids.
 
-    __slots__ = ("cols", "sel", "device")
+    ``full_len`` is the unsliced column length, tracked explicitly so a
+    view with zero columns (everything dropped) still knows its row count
+    — the host path streams empty rows in that case, and so must we.
+    """
 
-    def __init__(self, cols: Dict[str, StringColumn], sel: np.ndarray, device):
+    __slots__ = ("cols", "sel", "device", "full_len")
+
+    def __init__(
+        self, cols: Dict[str, StringColumn], sel: np.ndarray, device, full_len: int
+    ):
         self.cols = cols
         self.sel = sel
         self.device = device
+        self.full_len = full_len
 
     def materialize(self) -> DeviceTable:
         gathered = {n: c.gather(self.sel) for n, c in self.cols.items()}
@@ -73,7 +81,10 @@ def execute_plan(root: P.PlanNode) -> DeviceTable:
     assert isinstance(scan, P.Scan)
     table: DeviceTable = scan.table
     view = _View(
-        dict(table.columns), np.arange(table.nrows, dtype=np.int64), table.device
+        dict(table.columns),
+        np.arange(table.nrows, dtype=np.int64),
+        table.device,
+        table.nrows,
     )
 
     for node in stages[1:]:
@@ -90,12 +101,7 @@ def execute_plan(root: P.PlanNode) -> DeviceTable:
         elif isinstance(node, P.DropRows):
             view.sel = view.sel[node.n :]
         elif isinstance(node, P.SelectCols):
-            missing = [c for c in node.columns if c not in view.cols]
-            if missing:
-                # the host path fails at the first streamed row; use the
-                # 0-based position like the slice iterator (csvplus.go:242)
-                raise DataSourceError(0, MissingColumnError(missing[0]))
-            view.cols = {c: view.cols[c] for c in node.columns}
+            _apply_select(view, node.columns)
         elif isinstance(node, P.DropCols):
             view.cols = {
                 n: c for n, c in view.cols.items() if n not in set(node.columns)
@@ -115,6 +121,7 @@ def execute_plan(root: P.PlanNode) -> DeviceTable:
                 dict(joined.columns),
                 np.arange(joined.nrows, dtype=np.int64),
                 joined.device,
+                joined.nrows,
             )
         elif isinstance(node, P.Except):
             dev_index = node.index.device_table
@@ -129,6 +136,7 @@ def execute_plan(root: P.PlanNode) -> DeviceTable:
                 dict(stream.columns),
                 np.flatnonzero(keep).astype(np.int64),
                 stream.device,
+                stream.nrows,
             )
         else:
             raise UnsupportedPlan(f"no device lowering for {type(node).__name__}")
@@ -137,9 +145,43 @@ def execute_plan(root: P.PlanNode) -> DeviceTable:
 
 
 def _full_len(view: _View) -> int:
-    for c in view.cols.values():
-        return len(c)
-    return 0
+    return view.full_len
+
+
+def _apply_select(view: _View, columns) -> None:
+    """SelectCols with host-parity errors: the host path raises at the
+    first *streamed* row lacking the cell (csvplus.go:517-519 via
+    Row.Select), so an empty selection never errors, a schema-missing
+    column errors at position 0, and a heterogeneous absent cell errors
+    at its position within the selection."""
+    from .table import StringColumn as _SC
+    import numpy as _np
+
+    if view.sel.shape[0] == 0:
+        view.cols = {
+            c: view.cols.get(
+                c,
+                _SC(_np.empty(0, dtype=_np.str_), jnp_empty_i32(view.device)),
+            )
+            for c in columns
+        }
+        return
+    for c in columns:
+        if c not in view.cols:
+            raise DataSourceError(0, MissingColumnError(c))
+        col = view.cols[c]
+        if col.has_absent:
+            codes = _np.asarray(col.codes)[view.sel]
+            bad = _np.flatnonzero(codes < 0)
+            if bad.size:
+                raise DataSourceError(int(bad[0]), MissingColumnError(c))
+    view.cols = {c: view.cols[c] for c in columns}
+
+
+def jnp_empty_i32(device):
+    import jax.numpy as jnp
+
+    return jnp.empty(0, dtype=jnp.int32)
 
 
 def _apply_map(view: _View, expr) -> None:
@@ -156,11 +198,18 @@ def _apply_map(view: _View, expr) -> None:
     if isinstance(expr, Rename):
         # sequential pop/overwrite, matching the host expr exactly
         # (exprs.Rename: row[new] = row.pop(old) per mapping entry, so a
-        # rename onto an existing name overwrites it, and chained renames
-        # {'a':'b','b':'c'} cascade)
+        # rename onto an existing name overwrites it, chained renames
+        # {'a':'b','b':'c'} cascade, and a row WITHOUT the old cell keeps
+        # its existing new-column value)
+        from .table import merge_with_fallback
+
         for old, new in expr.mapping.items():
             if old in view.cols:
-                view.cols[new] = view.cols.pop(old)
+                moved = view.cols.pop(old)
+                existing = view.cols.pop(new, None)
+                if existing is not None and moved.has_absent:
+                    moved = merge_with_fallback(moved, existing)
+                view.cols[new] = moved
         return
     raise UnsupportedPlan(f"cannot lower map expression {expr!r} to device")
 
@@ -187,16 +236,9 @@ def plan_runner(root: P.PlanNode, fallback=None):
                 raise
             fallback(fn)
             return
-        rows = table.to_rows()
-        i = 0
-        try:
-            for i, row in enumerate(rows):
-                fn(row)
-        except StopPipeline:
-            return
-        except DataSourceError:
-            raise
-        except Exception as e:
-            raise DataSourceError(i, e) from e
+        from ..source import iterate
+
+        # rows are freshly decoded per run, so skip the defensive clone
+        iterate(table.to_rows(), fn, clone=False)
 
     return run

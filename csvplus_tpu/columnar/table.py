@@ -84,6 +84,19 @@ class StringColumn:
 
     dictionary: np.ndarray  # sorted unique values, host
     codes: jax.Array  # int32[n] on device; -1 = absent cell
+    _has_absent: "bool | None" = None  # lazy cache: any absent cells?
+
+    @property
+    def has_absent(self) -> bool:
+        """True when any cell is absent (one cached scalar device sync).
+
+        Columns parsed from CSV never have absent cells; only tables
+        columnarized from heterogeneous rows do, so most paths skip the
+        per-cell presence work entirely.
+        """
+        if self._has_absent is None:
+            self._has_absent = bool(jnp.any(self.codes < 0))
+        return self._has_absent
 
     @classmethod
     def from_values(cls, values: Sequence[str], device) -> "StringColumn":

@@ -25,35 +25,70 @@ csvplus.go:842,851-859 never flushes the trailing pending row; its own
 tests never check the index contents afterwards, so the data loss is
 invisible upstream).  This implementation keeps that row.
 
-The optional ``device_table`` attribute carries an HBM-resident columnar
-copy of the index (built by ``on_device()``), used by the device join/
-search kernels in M3+.
+TPU-native execution: an index built from a device-planned source is
+**device-resident and lazy** — the sort runs as a fused multi-key
+``lax.sort`` over dictionary codes (:mod:`..ops.sort`), the uniqueness
+check is one adjacent-equality reduction, ``find``/``sub_index`` binary-
+search the packed key array and decode *only the matching range*, and
+``resolve_duplicates`` with a named policy ("first"/"last") compacts via
+a run-boundary mask without ever materializing host rows.  Host rows are
+decoded on demand the first time a host-only operation (arbitrary
+callback, persistence, host join) needs them.
 """
 
 from __future__ import annotations
 
 import bisect
 import json
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple, Union
 
-from .errors import CsvPlusError
+import numpy as np
+
+from .errors import CsvPlusError, DataSourceError
 from .row import Row, all_columns_unique, equal_rows
 from .source import DataSource, RowFunc, iterate, take_rows
 
 _MAGIC = "csvplus-tpu-index"
 _VERSION = 1
 
+Resolver = Union[str, Callable[[List[Row]], Optional[Row]]]
+
 
 class IndexImpl:
     """Sorted rows + key column list (reference ``indexImpl``
-    csvplus.go:785-788)."""
+    csvplus.go:785-788).  ``rows`` may be lazily backed by a sorted
+    device table (``dev``), decoded on first host access."""
 
-    __slots__ = ("rows", "columns", "_keys")
+    __slots__ = ("_rows", "columns", "_keys", "dev")
 
-    def __init__(self, rows: List[Row], columns: Sequence[str]):
-        self.rows = rows
+    def __init__(self, rows: Optional[List[Row]], columns: Sequence[str], dev=None):
+        self._rows = rows
         self.columns = list(columns)
         self._keys: Optional[List[Tuple[str, ...]]] = None
+        self.dev = dev  # ops.join.DeviceIndex over the sorted columnar copy
+
+    # -- lazy materialization ---------------------------------------------
+
+    @property
+    def is_lazy(self) -> bool:
+        return self._rows is None
+
+    @property
+    def rows(self) -> List[Row]:
+        if self._rows is None:
+            assert self.dev is not None
+            self._rows = self.dev.table.to_rows()
+        return self._rows
+
+    @rows.setter
+    def rows(self, value: List[Row]) -> None:
+        self._rows = value
+        self._invalidate()
+
+    def __len__(self) -> int:
+        if self._rows is None and self.dev is not None:
+            return self.dev.table.nrows
+        return len(self.rows)
 
     # -- key cache ---------------------------------------------------------
 
@@ -78,11 +113,17 @@ class IndexImpl:
     # -- binary search (csvplus.go:869-920) --------------------------------
 
     def bounds(self, values: Sequence[str]) -> Tuple[int, int]:
-        """[lower, upper) range of rows whose key prefix equals *values*."""
-        if not values:
-            return 0, len(self.rows)
+        """[lower, upper) range of rows whose key prefix equals *values*.
+
+        Device-lazy indexes search the packed key array; materialized ones
+        bisect the host key tuples.
+        """
         if len(values) > len(self.columns):
             raise ValueError("too many columns in Index.find()")
+        if self._rows is None and self.dev is not None and self.dev.supported:
+            return self.dev.point_bounds(list(values))
+        if not values:
+            return 0, len(self.rows)
         k = len(values)
         v = tuple(values)
         keys = self.keys
@@ -91,8 +132,15 @@ class IndexImpl:
         return lower, upper
 
     def find_rows(self, values: Sequence[str]) -> List[Row]:
-        """Zero-copy row range matching the key prefix (csvplus.go:870-891)."""
+        """Row range matching the key prefix (csvplus.go:870-891).
+
+        On a device-lazy index only the matching range is decoded.
+        """
         lower, upper = self.bounds(values)
+        if self._rows is None and self.dev is not None:
+            if upper <= lower:
+                return []
+            return self.dev.table.to_rows(np.arange(lower, upper, dtype=np.int64))
         return self.rows[lower:upper]
 
     def has(self, values: Sequence[str]) -> bool:
@@ -124,7 +172,6 @@ class IndexImpl:
             i = j
         if changed:
             self.rows = out
-            self._invalidate()
 
 
 class Index:
@@ -135,9 +182,18 @@ class Index:
 
     def __init__(self, impl: IndexImpl):
         self._impl = impl
-        self.device_table = None  # set by on_device(); used by device kernels
+        # DeviceIndex over the sorted columnar copy (None = host-only);
+        # used by device joins/finds.  Kept in sync with impl.dev.
+        self.device_table = impl.dev
 
     # -- iteration ---------------------------------------------------------
+
+    def materialize(self) -> "Index":
+        """Decode a device-lazy index into host rows (idempotent).  Host
+        row-at-a-time consumers call this once instead of paying a device
+        round-trip per lookup."""
+        _ = self._impl.rows
+        return self
 
     def iterate(self, fn: RowFunc) -> None:
         """Iterate rows in key order, cloning each (csvplus.go:618-620)."""
@@ -149,7 +205,7 @@ class Index:
         return iter(take_rows(self._impl.rows))
 
     def __len__(self) -> int:
-        return len(self._impl.rows)
+        return len(self._impl)
 
     @property
     def columns(self) -> List[str]:
@@ -158,32 +214,75 @@ class Index:
     # -- queries -----------------------------------------------------------
 
     def find(self, *values: str) -> DataSource:
-        """Lazy source over all rows matching the key-value prefix
-        (csvplus.go:625-627)."""
+        """Lazy source over all Rows matching the key-value prefix
+        (csvplus.go:625-627); on a device index only the matching range
+        is ever decoded."""
         return take_rows(self._impl.find_rows(values))
 
     def sub_index(self, *values: str) -> "Index":
         """Index of the rows matching the key prefix, keyed on the
         remaining columns (csvplus.go:632-641)."""
-        if len(values) >= len(self._impl.columns):
+        impl = self._impl
+        if len(values) >= len(impl.columns):
             raise ValueError("too many values in SubIndex()")
-        return Index(
-            IndexImpl(
-                self._impl.find_rows(values),
-                self._impl.columns[len(values):],
-            )
-        )
+        rest = impl.columns[len(values):]
+        if impl.is_lazy and impl.dev is not None and impl.dev.supported:
+            from .ops.join import DeviceIndex
 
-    def resolve_duplicates(
-        self, resolve: Callable[[List[Row]], Optional[Row]]
-    ) -> None:
+            lower, upper = impl.dev.point_bounds(list(values))
+            sub_table = impl.dev.table.gather(
+                np.arange(lower, upper, dtype=np.int64)
+            )
+            return Index(IndexImpl(None, rest, dev=DeviceIndex.build(sub_table, rest)))
+        return Index(IndexImpl(impl.find_rows(values), rest))
+
+    def resolve_duplicates(self, resolve: Resolver) -> None:
         """Resolve groups of rows with duplicate keys (csvplus.go:643-653).
 
-        *resolve* receives each duplicate group and returns the single row
-        to keep, an empty row/None to drop the group, or raises to abort.
+        *resolve* is either a callback receiving each duplicate group and
+        returning the single row to keep (empty row/None drops the group,
+        raising aborts) — or a named device-friendly policy:
+
+        * ``"first"`` — keep the first row of each duplicate group (in
+          index order), equivalent to ``lambda g: g[0]``;
+        * ``"last"`` — keep the last row, equivalent to ``lambda g: g[-1]``.
+
+        Named policies on a device-lazy index compact via a run-boundary
+        mask on device without materializing host rows.
         """
-        self._impl.dedup(resolve)
-        self.device_table = None  # stale after mutation
+        impl = self._impl
+        if isinstance(resolve, str):
+            if resolve not in ("first", "last"):
+                raise ValueError(f"unknown duplicate-resolution policy {resolve!r}")
+            if impl.is_lazy and impl.dev is not None:
+                self._device_policy_dedup(resolve)
+                return
+            resolve = (lambda g: g[0]) if resolve == "first" else (lambda g: g[-1])
+        impl.dedup(resolve)
+        self.device_table = None  # columnar copy is stale after mutation
+        impl.dev = None
+
+    def _device_policy_dedup(self, policy: str) -> None:
+        from .ops.join import DeviceIndex
+        from .ops.sort import run_starts
+
+        impl = self._impl
+        table = impl.dev.table
+        starts = run_starts(table, impl.columns)
+        if policy == "first":
+            keep = starts
+        else:  # "last": a row is kept when the NEXT row starts a new run
+            keep = np.roll(starts, -1)
+            if keep.size:
+                keep[-1] = True
+        if keep.all():
+            return  # no duplicates; nothing to do
+        sel = np.flatnonzero(keep).astype(np.int64)
+        new_table = table.gather(sel)
+        impl.dev = DeviceIndex.build(new_table, impl.columns)
+        impl._rows = None
+        impl._invalidate()
+        self.device_table = impl.dev
 
     # -- persistence (csvplus.go:655-705) ----------------------------------
 
@@ -192,7 +291,7 @@ class Index:
         the reference's gob writer (csvplus.go:656-680).
 
         Format: versioned JSON-lines — a header object, then one row per
-        line.  (A gob-compatible shim is a non-goal; SURVEY.md §5.)
+        line.  (A gob-compat shim is a non-goal; SURVEY.md §5.)
         """
         from .sinks import _write_file
 
@@ -216,7 +315,7 @@ class Index:
 
     WriteTo = write_to
 
-    # -- device hook (M3) --------------------------------------------------
+    # -- device hook -------------------------------------------------------
 
     def on_device(self, device: str = "tpu") -> "Index":
         """Attach an HBM-resident columnar copy of this index so joins and
@@ -224,6 +323,7 @@ class Index:
         from .columnar.ingest import index_to_device
 
         self.device_table = index_to_device(self, device=device)
+        self._impl.dev = self.device_table
         return self
 
     OnDevice = on_device
@@ -254,13 +354,30 @@ def load_index(file_name: str) -> Index:
     return Index(IndexImpl(rows, header["columns"]))
 
 
-def create_index(src, columns: Sequence[str]) -> Index:
-    """Materialize and sort an index (csvplus.go:707-738)."""
+def _validate_index_columns(columns: Sequence[str]) -> Tuple[str, ...]:
     columns = tuple(columns)
     if len(columns) == 0:
         raise ValueError("empty column list in CreateIndex()")
     if len(columns) > 1 and not all_columns_unique(columns):
         raise ValueError("duplicate column name(s) in CreateIndex()")
+    return columns
+
+
+def create_index(src, columns: Sequence[str]) -> Index:
+    """Materialize and sort an index (csvplus.go:707-738).
+
+    A device-planned source builds the index entirely on device: fused
+    multi-key ``lax.sort`` over dictionary codes, no host rows.
+    """
+    columns = _validate_index_columns(columns)
+
+    if getattr(src, "plan", None) is not None:
+        from .columnar.exec import UnsupportedPlan
+
+        try:
+            return _create_index_device(src.plan, columns)
+        except UnsupportedPlan:
+            pass  # fall through to the host build
 
     rows: List[Row] = []
 
@@ -277,11 +394,52 @@ def create_index(src, columns: Sequence[str]) -> Index:
     return Index(impl)
 
 
+def _create_index_device(plan, columns: Tuple[str, ...]) -> Index:
+    from .columnar.exec import execute_plan
+    from .ops.join import DeviceIndex
+    from .ops.sort import sort_table
+
+    table = execute_plan(plan)
+    for col in columns:
+        if col not in table.columns:
+            raise DataSourceError(
+                0, f'missing column "{col}" while creating an index'
+            )
+        codes = np.asarray(table.columns[col].codes)
+        absent = np.flatnonzero(codes < 0)
+        if absent.size:
+            raise DataSourceError(
+                int(absent[0]),
+                f'missing column "{col}" while creating an index',
+            )
+    sorted_table = sort_table(table, list(columns))
+    dev = DeviceIndex.build(sorted_table, list(columns))
+    return Index(IndexImpl(None, columns, dev=dev))
+
+
 def create_unique_index(src, columns: Sequence[str]) -> Index:
-    """Index build + duplicate-key check (csvplus.go:740-756)."""
+    """Index build + duplicate-key check (csvplus.go:740-756).
+
+    On a device index the check is a single adjacent-equality reduction
+    over the sorted key codes; only the offending row (if any) is decoded.
+    """
     index = create_index(src, columns)
-    rows = index._impl.rows
-    cols = index._impl.columns
+    impl = index._impl
+    cols = impl.columns
+
+    if impl.is_lazy and impl.dev is not None:
+        from .ops.sort import find_adjacent_duplicate
+
+        i = find_adjacent_duplicate(impl.dev.table, cols)
+        if i is not None:
+            row = impl.dev.table.to_rows(np.array([i], dtype=np.int64))[0]
+            raise CsvPlusError(
+                "duplicate value while creating unique index: "
+                + str(row.select_existing(*cols))
+            )
+        return index
+
+    rows = impl.rows
     for i in range(1, len(rows)):
         if equal_rows(cols, rows[i - 1], rows[i]):
             raise CsvPlusError(

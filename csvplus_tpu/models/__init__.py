@@ -1,0 +1,9 @@
+"""Canned workload pipelines ("model families" of this framework).
+
+Each module packages one of BASELINE.json's benchmark configs as a
+reusable, jit-compiled pipeline over columnar tables:
+
+* :mod:`.flagship` — the north-star 3-way lookup join
+  (orders ⋈ customers ⋈ products, README.md:54-65) as a single fused
+  SPMD step, single-chip or mesh-sharded.
+"""

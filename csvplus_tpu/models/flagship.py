@@ -1,0 +1,142 @@
+"""The flagship workload: 3-way lookup join as one fused device step.
+
+Reference call stack being replaced (SURVEY.md §3.3): per orders row, two
+host binary searches with per-comparison map lookups + two map merges
+(csvplus.go:552-583).  Here the whole thing is ONE jit-compiled step over
+dictionary codes:
+
+* both build sides (customers, products) are unique indexes, so each
+  stream row matches at most one build row — the output is statically
+  shaped ``(n_orders,)`` and the entire step (two vectorized binary
+  searches + attribute gathers + validity mask) fuses on device;
+* the probe keys are the orders' key columns pre-translated into each
+  index's dictionary space (host translation table + device gather at
+  build time);
+* sharded mode lays the orders out row-sharded over a 1-D mesh and
+  replicates the (small) key arrays — XLA runs the step data-parallel
+  with no collectives in the hot loop; the partitioned all-to-all path
+  (:mod:`..parallel.pjoin`) covers build sides too large to replicate.
+
+``step`` is the jittable "forward step" exposed through
+``__graft_entry__.entry()``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.table import DeviceTable, StringColumn
+from ..ops.join import DeviceIndex
+
+
+@jax.jit
+def threeway_step(
+    cust_keys: jax.Array,  # sorted unique customer key codes
+    prod_keys: jax.Array,  # sorted unique product key codes
+    qk_cust: jax.Array,  # orders' cust key, translated codes (-1 = miss)
+    qk_prod: jax.Array,  # orders' prod key, translated codes
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One fused probe step: (cust row id, prod row id, valid mask)."""
+    lo_c = jnp.searchsorted(cust_keys, qk_cust, side="left")
+    lo_c = jnp.minimum(lo_c, cust_keys.shape[0] - 1)
+    hit_c = (jnp.take(cust_keys, lo_c, axis=0) == qk_cust) & (qk_cust >= 0)
+
+    lo_p = jnp.searchsorted(prod_keys, qk_prod, side="left")
+    lo_p = jnp.minimum(lo_p, prod_keys.shape[0] - 1)
+    hit_p = (jnp.take(prod_keys, lo_p, axis=0) == qk_prod) & (qk_prod >= 0)
+
+    valid = hit_c & hit_p
+    return lo_c.astype(jnp.int32), lo_p.astype(jnp.int32), valid
+
+
+@jax.jit
+def gather_columns(ids: jax.Array, valid: jax.Array, *code_arrays: jax.Array):
+    """Gather attribute code columns by build row id, masking misses."""
+    out = []
+    for codes in code_arrays:
+        g = jnp.take(codes, jnp.where(valid, ids, 0), axis=0)
+        out.append(jnp.where(valid, g, -1))
+    return tuple(out)
+
+
+@dataclass
+class ThreewayJoin:
+    """Prepared flagship pipeline: upload once, step many times."""
+
+    cust: DeviceIndex
+    prod: DeviceIndex
+    qk_cust: jax.Array
+    qk_prod: jax.Array
+    orders_cols: Dict[str, StringColumn]
+    n_orders: int
+
+    @classmethod
+    def build(
+        cls,
+        orders: DeviceTable,
+        cust_index: DeviceIndex,
+        prod_index: DeviceIndex,
+        cust_col: str = "cust_id",
+        prod_col: str = "prod_id",
+    ) -> "ThreewayJoin":
+        assert len(cust_index.key_columns) == 1 and len(prod_index.key_columns) == 1
+        qk_c = orders.columns[cust_col].renumbered_to(
+            cust_index.table.columns[cust_index.key_columns[0]].dictionary
+        )
+        qk_p = orders.columns[prod_col].renumbered_to(
+            prod_index.table.columns[prod_index.key_columns[0]].dictionary
+        )
+        return cls(
+            cust=cust_index,
+            prod=prod_index,
+            qk_cust=qk_c,
+            qk_prod=qk_p,
+            orders_cols=dict(orders.columns),
+            n_orders=orders.nrows,
+        )
+
+    def step(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """The fused probe step (jit-compiled, device-resident)."""
+        return threeway_step(
+            self.cust.packed_i32, self.prod.packed_i32, self.qk_cust, self.qk_prod
+        )
+
+    def run(self) -> DeviceTable:
+        """Full join: probe, compact to matches, merge columns.
+
+        Column merge semantics match the reference (csvplus.go:571-583):
+        both index's columns and stream's columns survive; stream wins on
+        name collision; stream row order is preserved.
+        """
+        lo_c, lo_p, valid = self.step()
+        valid_np = np.asarray(valid)
+        sel = np.flatnonzero(valid_np)
+        sel_dev = jnp.asarray(sel, dtype=jnp.int32)
+
+        ids_c = jnp.take(lo_c, sel_dev, axis=0)
+        ids_p = jnp.take(lo_p, sel_dev, axis=0)
+
+        out: Dict[str, StringColumn] = {}
+        for name, col in self.cust.table.columns.items():
+            out[name] = StringColumn(col.dictionary, jnp.take(col.codes, ids_c, axis=0))
+        for name, col in self.prod.table.columns.items():
+            out[name] = StringColumn(col.dictionary, jnp.take(col.codes, ids_p, axis=0))
+        for name, col in self.orders_cols.items():  # stream wins
+            out[name] = StringColumn(col.dictionary, jnp.take(col.codes, sel_dev, axis=0))
+        device = next(iter(out.values())).codes.device if out else None
+        return DeviceTable(out, int(sel.shape[0]), device)
+
+
+def example_step_args(n_orders: int = 4096, n_cust: int = 512, n_prod: int = 64):
+    """Deterministic small example inputs for compile checks."""
+    cust_keys = jnp.arange(n_cust, dtype=jnp.int32)
+    prod_keys = jnp.arange(n_prod, dtype=jnp.int32)
+    qk_c = jnp.arange(n_orders, dtype=jnp.int32) % (n_cust + 7) - 3
+    qk_p = jnp.arange(n_orders, dtype=jnp.int32) % (n_prod + 3) - 1
+    return cust_keys, prod_keys, qk_c, qk_p

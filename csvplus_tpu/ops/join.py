@@ -105,6 +105,42 @@ class DeviceIndex:
     def supported(self) -> bool:
         return self.shifts is not None
 
+    def point_bounds(self, values: List[str]) -> Tuple[int, int]:
+        """[lower, upper) range for one key-prefix probe — the device form
+        of the reference's two binary searches (csvplus.go:881-887).
+
+        Values are translated to codes via host dictionary lookups (a few
+        binary searches over host arrays), then the packed key array is
+        searched; only two scalars cross back from device.
+        """
+        if len(values) > len(self.key_columns):
+            raise ValueError("too many columns in Index.find()")
+        assert self.supported
+        if not values:
+            return 0, self.table.nrows
+        from ..columnar.table import lookup_code
+
+        qk = 0
+        for v, name, s in zip(values, self.key_columns, self.shifts):
+            code = lookup_code(self.table.columns[name].dictionary, v)
+            if code < 0:
+                return 0, 0  # value not in the index at all
+            qk |= code << s
+        range_size = 1 << self.shifts[len(values) - 1]
+        if self.packed_i32 is not None:
+            res = jnp.searchsorted(
+                self.packed_i32,
+                jnp.asarray([qk, qk + range_size], dtype=jnp.int32),
+                side="left",
+            )
+            res = np.asarray(res)
+            return int(res[0]), int(res[1])
+        lower = int(np.searchsorted(self.packed_i64, np.int64(qk), side="left"))
+        upper = int(
+            np.searchsorted(self.packed_i64, np.int64(qk + range_size), side="left")
+        )
+        return lower, upper
+
     def _translated(self, probe_cols: List[StringColumn], n_key_cols: int):
         """Per-column probe codes translated into the build dictionaries."""
         out = []

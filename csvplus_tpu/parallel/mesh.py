@@ -1,0 +1,47 @@
+"""Mesh + sharding helpers.
+
+One flat data axis ("shards") is the natural mesh for a columnar ETL
+engine: rows are the only dimension that scales.  Collectives ride ICI
+within a slice; a future multi-slice mesh would add a DCN axis and keep
+the same named-sharding code (XLA routes per-axis).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "shards"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D mesh over the first *n_devices* devices (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def shard_rows(mesh: Mesh, x: "jax.Array | np.ndarray") -> jax.Array:
+    """Place *x* row-sharded over the mesh (dim 0 split across shards)."""
+    return jax.device_put(x, NamedSharding(mesh, P(AXIS)))
+
+
+def replicate(mesh: Mesh, x: "jax.Array | np.ndarray") -> jax.Array:
+    """Place *x* fully replicated over the mesh."""
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def pad_to_multiple(x: np.ndarray, n: int, fill) -> "tuple[np.ndarray, int]":
+    """Pad dim 0 up to a multiple of *n*; returns (padded, original_len)."""
+    m = x.shape[0]
+    rem = (-m) % n
+    if rem == 0:
+        return x, m
+    pad = np.full((rem,) + x.shape[1:], fill, dtype=x.dtype)
+    return np.concatenate([x, pad]), m

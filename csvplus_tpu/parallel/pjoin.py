@@ -1,0 +1,214 @@
+"""Partitioned lookup join: ICI all-to-all key shuffle inside shard_map.
+
+This is the rebuild's replacement for the reference's per-row host binary
+search (csvplus.go:552-568) at multi-chip scale — BASELINE.json config 5:
+"8-way sharded orders.csv join across v5e-8 with ICI all-to-all key
+shuffle".
+
+Design (SPMD, static shapes throughout — no data-dependent control flow
+inside jit):
+
+* the build side is the sorted packed key array of a device index,
+  **range-partitioned**: contiguous slices of the sorted array go to each
+  shard, with slice boundaries snapped to equal-key run starts so every
+  key's full match range lives on exactly one shard (no boundary
+  straddling, no double-probing);
+* each shard routes its local probe keys to the owning shard via one
+  ``lax.sort`` by destination + a scatter into an ``(N, C)`` slot buffer
+  + ``lax.all_to_all`` (this is the ICI shuffle);
+* the owner answers every received probe with ``(global lower bound,
+  match count)`` from a vectorized local binary search, and a reverse
+  ``all_to_all`` returns answers through the same slots, so no
+  permutation metadata ever crosses the wire;
+* capacity ``C`` (slots per destination) is a static compile-time
+  parameter; overflow is detected on device (-1 sentinel) and the probe
+  retries with doubled capacity — the count -> allocate -> fill pattern
+  with a geometric backoff instead of a second counting pass.
+
+Skew note: a single key whose duplicate run exceeds one shard's slice
+still lands on one shard (run-start snapping makes the slice grow); heavy
+-hitter salting (JSPIM-style) is future work and documented as such.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+try:  # moved out of experimental in newer jax
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS, pad_to_multiple
+
+_SENTINEL = np.int32(np.iinfo(np.int32).max)
+
+
+def partition_sorted_keys(
+    keys: np.ndarray, n_shards: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Range-partition a sorted int32 key array into equal padded slices.
+
+    Returns (local_keys[(N, k)] padded with SENTINEL, splits[(N,)] =
+    first key per shard, base[(N,)] = global row offset per shard).
+    Slice boundaries are snapped to run starts so one key never spans
+    two shards.
+    """
+    n = keys.shape[0]
+    if n == 0:
+        return (
+            np.full((n_shards, 1), _SENTINEL, dtype=np.int32),
+            np.full(n_shards, _SENTINEL, dtype=np.int32),
+            np.zeros(n_shards, dtype=np.int32),
+        )
+    starts = np.flatnonzero(np.concatenate([[True], keys[1:] != keys[:-1]]))
+    targets = (np.arange(n_shards) * n) // n_shards
+    # boundary s = first run start >= target (so runs never straddle)
+    bidx = np.searchsorted(starts, targets, side="left")
+    bounds = np.where(
+        bidx < starts.shape[0], starts[np.minimum(bidx, starts.shape[0] - 1)], n
+    ).astype(np.int64)
+    bounds[0] = 0
+    ends = np.append(bounds[1:], n)
+    sizes = ends - bounds
+    k = max(int(sizes.max()), 1)
+    local = np.full((n_shards, k), _SENTINEL, dtype=np.int32)
+    for s in range(n_shards):
+        local[s, : sizes[s]] = keys[bounds[s] : ends[s]]
+    # splits must be non-decreasing for the routing binary search: an empty
+    # shard inherits the NEXT non-empty shard's first key, so equal splits
+    # route (via side='right') to the right-most shard — the actual owner.
+    splits = np.full(n_shards, _SENTINEL, dtype=np.int32)
+    nxt = _SENTINEL
+    for s in range(n_shards - 1, -1, -1):
+        if sizes[s] > 0:
+            nxt = local[s, 0]
+        splits[s] = nxt
+    return local, splits, bounds.astype(np.int32)
+
+
+def _probe_shard_kernel(n_shards: int, capacity: int, qk, keys_local, splits, base):
+    """Per-shard body (runs under shard_map): route, exchange, probe,
+    route back.  All shapes static."""
+    m = qk.shape[0]
+    N, C = n_shards, capacity
+
+    valid = qk >= 0
+    dest = jnp.clip(jnp.searchsorted(splits, qk, side="right") - 1, 0, N - 1)
+    dest = jnp.where(valid, dest, 0).astype(jnp.int32)
+
+    # stable sort by destination, carrying the key and original position
+    pos = jnp.arange(m, dtype=jnp.int32)
+    dest_s, qk_s, pos_s = lax.sort((dest, qk, pos), num_keys=1, is_stable=True)
+
+    # rank of each query within its destination group
+    group_start = jnp.searchsorted(dest_s, jnp.arange(N, dtype=jnp.int32), side="left")
+    rank = jnp.arange(m, dtype=jnp.int32) - group_start[dest_s]
+    ok = rank < C  # overflow -> sentinel result, caller retries bigger C
+
+    # scatter into (N, C) slot buffer; overflowing ranks drop out of bounds
+    buf = jnp.full((N, C), -1, dtype=jnp.int32)
+    buf = buf.at[dest_s, jnp.where(ok, rank, C)].set(
+        jnp.where(valid[pos_s], qk_s, -1), mode="drop"
+    )
+
+    # ICI shuffle: slot-aligned exchange
+    recv = lax.all_to_all(buf, AXIS, split_axis=0, concat_axis=0, tiled=True)
+
+    # vectorized local binary search over this shard's slice
+    q = recv.reshape(-1)
+    lo = jnp.searchsorted(keys_local, q, side="left")
+    hi = jnp.searchsorted(keys_local, q, side="right")
+    found = (hi > lo) & (q >= 0)
+    my_base = base[lax.axis_index(AXIS)]
+    resp_lo = jnp.where(found, lo.astype(jnp.int32) + my_base, -1)
+    resp_ct = jnp.where(found, (hi - lo).astype(jnp.int32), 0)
+
+    # answers ride home through the same slots
+    back_lo = lax.all_to_all(
+        resp_lo.reshape(N, C), AXIS, split_axis=0, concat_axis=0, tiled=True
+    )
+    back_ct = lax.all_to_all(
+        resp_ct.reshape(N, C), AXIS, split_axis=0, concat_axis=0, tiled=True
+    )
+
+    got_lo = jnp.where(ok, back_lo[dest_s, jnp.minimum(rank, C - 1)], -1)
+    got_ct = jnp.where(ok, back_ct[dest_s, jnp.minimum(rank, C - 1)], -1)
+
+    # un-permute to original local order
+    out_lo = jnp.zeros(m, jnp.int32).at[pos_s].set(got_lo)
+    out_ct = jnp.zeros(m, jnp.int32).at[pos_s].set(got_ct)
+    return out_lo, out_ct
+
+
+@partial(jax.jit, static_argnames=("mesh", "n_shards", "capacity"))
+def _probe_spmd(mesh, n_shards, capacity, qk_sharded, keys_local, splits, base):
+    f = shard_map(
+        partial(_probe_shard_kernel, n_shards, capacity),
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(), P()),
+        out_specs=(P(AXIS), P(AXIS)),
+    )
+    return f(qk_sharded, keys_local, splits, base)
+
+
+def partitioned_probe(
+    mesh: Mesh,
+    stream_keys: np.ndarray,
+    index_keys_sorted: np.ndarray,
+    capacity: "int | None" = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """All-to-all partitioned probe: for every stream key, the global
+    ``[lower, lower+count)`` match range in the sorted index key array.
+
+    Host-facing wrapper: pads, shards, runs the SPMD kernel, retries on
+    capacity overflow, unpads.  Keys must be int32 packed keys with -1
+    for invalid probes (absent/unmatched dictionary translation).
+    """
+    n_shards = mesh.devices.size
+    local, splits, base = partition_sorted_keys(
+        index_keys_sorted.astype(np.int32), n_shards
+    )
+
+    qk, true_len = pad_to_multiple(stream_keys.astype(np.int32), n_shards, np.int32(-1))
+    m_per_shard = qk.shape[0] // n_shards
+    if capacity is None:
+        # expect near-uniform routing; retry doubles on skew overflow
+        capacity = max(64, 2 * ((m_per_shard + n_shards - 1) // n_shards))
+    capacity = 1 << (int(capacity) - 1).bit_length()  # pow2 buckets limit recompiles
+
+    qk_dev = jax.device_put(qk, NamedSharding(mesh, P(AXIS)))
+    keys_dev = jax.device_put(local.reshape(-1), NamedSharding(mesh, P(AXIS)))
+    splits_dev = jax.device_put(splits, NamedSharding(mesh, P()))
+    base_dev = jax.device_put(base, NamedSharding(mesh, P()))
+
+    while True:
+        lo, ct = _probe_spmd(
+            mesh, n_shards, capacity, qk_dev, keys_dev, splits_dev, base_dev
+        )
+        ct_np = np.asarray(ct)
+        if not (ct_np < 0).any():
+            return np.asarray(lo)[:true_len], ct_np[:true_len]
+        if capacity >= qk.shape[0]:
+            raise RuntimeError("partitioned_probe: capacity overflow at maximum")
+        capacity *= 2  # skewed routing: geometric retry
+
+
+@jax.jit
+def broadcast_probe(index_keys, qk_sharded):
+    """Small-build-side fast path: the sorted key array is replicated to
+    every shard (the analogue of the reference keeping the whole index in
+    memory) and each shard binary-searches its own row slice; XLA
+    parallelizes over the row sharding with zero collectives in the probe
+    itself."""
+    lower = jnp.searchsorted(index_keys, qk_sharded, side="left")
+    upper = jnp.searchsorted(index_keys, qk_sharded, side="right")
+    counts = jnp.where(qk_sharded >= 0, upper - lower, 0)
+    return lower.astype(jnp.int32), counts.astype(jnp.int32)

@@ -1,0 +1,88 @@
+"""Row-sharded columnar tables.
+
+The sharded column store from SURVEY.md §2's rebuild table: the same
+dictionary-encoded columns as :class:`~csvplus_tpu.columnar.table
+.DeviceTable`, but with code arrays laid out row-sharded over a 1-D mesh
+(``NamedSharding(mesh, P("shards"))``).  Rows are padded to a multiple of
+the shard count with code -1 (absent), and a validity cutoff tracks the
+true length — padding never leaks into results.
+
+Dictionaries stay on the host and are replicated conceptually: they are
+only consulted for encode/decode and value->code translation, which are
+host operations by design.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..columnar.table import DeviceTable, StringColumn, encode_strings
+from ..row import Row
+from .mesh import AXIS, pad_to_multiple, replicate, shard_rows
+
+
+class ShardedTable:
+    """Equal-length dictionary-encoded columns, row-sharded over a mesh."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        columns: Dict[str, StringColumn],
+        nrows: int,
+        padded: int,
+    ):
+        self.mesh = mesh
+        self.columns = columns  # codes arrays are sharded, length `padded`
+        self.nrows = nrows  # true row count (<= padded)
+        self.padded = padded
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.devices.size
+
+    @classmethod
+    def from_table(cls, table: DeviceTable, mesh: Mesh) -> "ShardedTable":
+        """Re-lay a single-device table across the mesh."""
+        n = mesh.devices.size
+        cols = {}
+        padded = table.nrows
+        for name, col in table.columns.items():
+            codes, _ = pad_to_multiple(np.asarray(col.codes), n, np.int32(-1))
+            padded = codes.shape[0]
+            cols[name] = StringColumn(col.dictionary, shard_rows(mesh, codes))
+        return cls(mesh, cols, table.nrows, padded)
+
+    @classmethod
+    def from_pylists(
+        cls, data: Dict[str, Sequence[str]], mesh: Mesh
+    ) -> "ShardedTable":
+        n = mesh.devices.size
+        cols = {}
+        nrows = padded = 0
+        for name, values in data.items():
+            dictionary, codes = encode_strings(values)
+            nrows = codes.shape[0]
+            codes, _ = pad_to_multiple(codes, n, np.int32(-1))
+            padded = codes.shape[0]
+            cols[name] = StringColumn(dictionary, shard_rows(mesh, codes))
+        return cls(mesh, cols, nrows, padded)
+
+    def to_table(self) -> DeviceTable:
+        """Gather back to one device (drops padding)."""
+        cols = {}
+        for name, col in self.columns.items():
+            codes = np.asarray(col.codes)[: self.nrows]
+            cols[name] = StringColumn(col.dictionary, jnp.asarray(codes))
+        return DeviceTable(cols, self.nrows, jax.devices()[0])
+
+    def to_rows(self) -> List[Row]:
+        return self.to_table().to_rows()
+
+    def column_codes(self, name: str) -> jax.Array:
+        return self.columns[name].codes

@@ -39,17 +39,18 @@ from .row import Row, merge_rows
 RowFunc = Callable[[Row], None]  # raises to stop/fail (Go: func(Row) error)
 
 
-def iterate(rows: Sequence[Row], fn: RowFunc) -> None:
+def iterate(rows: Sequence[Row], fn: RowFunc, clone: bool = True) -> None:
     """Drive *fn* over a row slice, cloning each row (csvplus.go:225-249).
 
     Errors raised by *fn* are wrapped in :class:`DataSourceError` with the
     0-based position of the offending row, matching the reference's
-    ``Line: uint64(i)``.
+    ``Line: uint64(i)``.  ``clone=False`` skips the defensive copy for
+    callers whose rows are already single-use (freshly decoded).
     """
     i = 0
     try:
         for i, row in enumerate(rows):
-            fn(Row(row))  # Row(row) is already a fresh copy
+            fn(Row(row) if clone else row)  # Row(row) is a fresh copy
     except StopPipeline:
         return
     except DataSourceError:
@@ -313,6 +314,8 @@ class DataSource:
         cols = _resolve_join_columns(index, columns, "Join()")
 
         def run(fn: RowFunc) -> None:
+            index.materialize()  # host probe loop: decode a lazy index once
+
             def step(row: Row) -> None:
                 values = row.select_values(*cols)
                 for index_row in index._impl.find_rows(values):
@@ -329,6 +332,8 @@ class DataSource:
         cols = _resolve_join_columns(index, columns, "Except()")
 
         def run(fn: RowFunc) -> None:
+            index.materialize()  # host probe loop: decode a lazy index once
+
             def step(row: Row) -> None:
                 values = row.select_values(*cols)
                 if not index._impl.has(values):

@@ -310,3 +310,64 @@ def test_device_select_missing_column_row_number(dev_people):
     with pytest.raises(DataSourceError) as e:
         dev_people.select_columns("id", "zzz").to_rows()
     assert str(e.value) == 'row 0: missing column "zzz"'
+
+
+def test_policy_dedup_invalidates_stale_device_index(people_csv):
+    """Named-policy dedup on a materialized index must drop the stale
+    columnar copy so device joins can't see removed rows (review regr.)."""
+    from csvplus_tpu import TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    rows = [Row({"k": "a", "v": "1"}), Row({"k": "a", "v": "2"}), Row({"k": "b", "v": "3"})]
+    idx = TakeRows(rows).index_on("k")
+    idx.on_device("cpu")
+    idx.resolve_duplicates("first")
+    assert idx.device_table is None  # stale copy dropped
+    stream = source_from_table(DeviceTable.from_pylists({"k": ["a", "b"]}, device="cpu"))
+    host = TakeRows([Row({"k": "a"}), Row({"k": "b"})]).join(idx, "k").to_rows()
+    assert stream.join(idx, "k").to_rows() == host
+    assert len(host) == 2
+
+
+def test_rename_absent_cells_keep_destination(people_csv):
+    """Rename with absent source cells must not destroy the destination
+    column (review regression)."""
+    from csvplus_tpu import TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+    from csvplus_tpu import Rename as R
+
+    rows = [Row({"b": "KEEP"}), Row({"a": "y"})]
+    host = TakeRows(rows).map(R({"a": "b"})).to_rows()
+    dev = source_from_table(DeviceTable.from_rows(rows, device="cpu")).map(
+        R({"a": "b"})
+    ).to_rows()
+    assert dev == host == [Row({"b": "KEEP"}), Row({"b": "y"})]
+
+
+def test_select_columns_absent_cell_errors(people_csv):
+    """Device SelectCols checks per-row cell presence (review regression)."""
+    from csvplus_tpu import TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    rows = [Row({"a": "x", "b": "1"}), Row({"a": "y"})]
+    dev = source_from_table(DeviceTable.from_rows(rows, device="cpu"))
+    with pytest.raises(DataSourceError) as e:
+        dev.select_columns("b").to_rows()
+    assert 'missing column "b"' in str(e.value)
+    # empty selection: no rows streamed -> no error, like the host path
+    assert dev.top(0).select_columns("zzz").to_rows() == []
+
+
+def test_filter_after_dropping_all_columns(dev_people, host_people):
+    """Zero-column views keep their row count (review regression)."""
+    stage = lambda s: s.drop_columns("id", "name", "surname", "born").filter(
+        Not(Like({"a": "x"}))
+    )
+    same(stage(dev_people).to_rows(), stage(host_people).to_rows())
+    gone = lambda s: s.drop_columns("id", "name", "surname", "born").filter(
+        Like({"a": "x"})
+    )
+    same(gone(dev_people).to_rows(), gone(host_people).to_rows())

@@ -1,0 +1,151 @@
+"""Device-native index build (M3): lax.sort build, lazy decode, device
+unique check, packed-key find, policy dedup — all differential vs host."""
+
+import numpy as np
+import pytest
+
+from csvplus_tpu import (
+    CsvPlusError,
+    DataSourceError,
+    Like,
+    Row,
+    Take,
+    TakeRows,
+    from_file,
+)
+
+
+@pytest.fixture()
+def dev_people(people_csv):
+    return from_file(people_csv).on_device("cpu")
+
+
+@pytest.fixture()
+def host_people(people_csv):
+    return Take(from_file(people_csv))
+
+
+def test_device_index_is_lazy(dev_people):
+    idx = dev_people.index_on("surname", "name")
+    assert idx._impl.is_lazy
+    assert idx.device_table is not None and idx.device_table.supported
+    assert len(idx) == 120  # length without materializing
+    assert idx._impl.is_lazy
+
+
+def test_device_index_sorted_same_as_host(dev_people, host_people):
+    di = dev_people.index_on("surname", "name")
+    hi = host_people.index_on("surname", "name")
+    assert Take(di).to_rows() == Take(hi).to_rows()
+
+
+def test_device_index_stability_matches_host(dev_people, host_people):
+    """Stable device sort == stable host sort, including ties."""
+    di = dev_people.index_on("name")  # 12 ties per name
+    hi = host_people.index_on("name")
+    assert Take(di).to_rows() == Take(hi).to_rows()
+
+
+def test_device_index_missing_column(dev_people):
+    with pytest.raises(DataSourceError) as e:
+        dev_people.index_on("name", "xxx")
+    assert str(e.value).endswith('missing column "xxx" while creating an index')
+
+
+def test_device_unique_index(dev_people, host_people):
+    assert len(dev_people.unique_index_on("id")) == 120
+    with pytest.raises(CsvPlusError) as e:
+        dev_people.unique_index_on("name")
+    assert "duplicate value while creating unique index:" in str(e.value)
+    # same message as host
+    with pytest.raises(CsvPlusError) as e2:
+        host_people.unique_index_on("name")
+    # both report a name-only row; exact dup row may differ (host scans
+    # materialized order == device order, so they should in fact agree)
+    assert str(e.value) == str(e2.value)
+
+
+def test_device_find_decodes_range_only(dev_people, host_people):
+    di = dev_people.index_on("name", "surname")
+    hi = host_people.index_on("name", "surname")
+    assert di._impl.is_lazy
+    assert di.find("Amelia").to_rows() == hi.find("Amelia").to_rows()
+    assert di._impl.is_lazy  # find() must not have materialized the index
+    assert di.find("Amelia", "Smith").to_rows() == hi.find("Amelia", "Smith").to_rows()
+    assert di.find("NoSuch").to_rows() == []
+    assert di.find().to_rows() == hi.find().to_rows()
+    with pytest.raises(ValueError):
+        di.find("a", "b", "c").to_rows()
+
+
+def test_device_sub_index(dev_people, host_people):
+    di = dev_people.index_on("name", "surname")
+    hi = host_people.index_on("name", "surname")
+    ds, hs = di.sub_index("Olivia"), hi.sub_index("Olivia")
+    assert ds.columns == hs.columns == ["surname"]
+    assert Take(ds).to_rows() == Take(hs).to_rows()
+    assert ds.find("Jones").to_rows() == hs.find("Jones").to_rows()
+    with pytest.raises(ValueError):
+        di.sub_index("a", "b")
+
+
+def test_device_index_in_device_join(dev_people, orders_csv):
+    """Index built on device feeds the device join without materializing."""
+    idx = dev_people.select_columns("id", "name", "surname").unique_index_on("id")
+    assert idx._impl.is_lazy
+    dev_orders = from_file(orders_csv).on_device("cpu").select_columns(
+        "cust_id", "qty"
+    )
+    out = dev_orders.join(idx, "cust_id").to_rows()
+    assert len(out) == 10_000
+    assert idx._impl.is_lazy  # device join never decoded the index
+
+
+def test_device_index_in_host_join_materializes_once(dev_people, orders_csv):
+    idx = dev_people.select_columns("id", "name").unique_index_on("id")
+    host_orders = Take(from_file(orders_csv).select_columns("cust_id", "qty"))
+    out = host_orders.join(idx, "cust_id").to_rows()
+    assert len(out) == 10_000
+    assert not idx._impl.is_lazy  # decoded once for the host probe loop
+
+
+def test_policy_dedup_device_vs_host(dev_people, host_people):
+    for policy in ("first", "last"):
+        di = dev_people.index_on("name")
+        hi = host_people.index_on("name")
+        di.resolve_duplicates(policy)
+        hi.resolve_duplicates(policy)
+        assert di._impl.is_lazy  # stayed on device
+        assert Take(di).to_rows() == Take(hi).to_rows()
+        assert len(di) == 10
+
+
+def test_policy_dedup_equivalent_to_callback(host_people):
+    hi1 = host_people.index_on("name")
+    hi2 = host_people.index_on("name")
+    hi1.resolve_duplicates("first")
+    hi2.resolve_duplicates(lambda g: g[0])
+    assert Take(hi1).to_rows() == Take(hi2).to_rows()
+    with pytest.raises(ValueError):
+        hi1.resolve_duplicates("median")
+
+
+def test_callback_dedup_on_device_index(dev_people, host_people):
+    """Arbitrary callbacks force materialization but stay correct."""
+    di = dev_people.index_on("name")
+    hi = host_people.index_on("name")
+    pick = lambda g: g[len(g) // 2]
+    di.resolve_duplicates(pick)
+    hi.resolve_duplicates(pick)
+    assert Take(di).to_rows() == Take(hi).to_rows()
+    assert di.device_table is None  # stale columnar copy dropped
+
+
+def test_device_index_persistence_roundtrip(dev_people, tmp_path):
+    from csvplus_tpu import load_index
+
+    di = dev_people.index_on("id")
+    path = str(tmp_path / "dev.index")
+    di.write_to(path)
+    back = load_index(path)
+    assert Take(back).to_rows() == Take(di).to_rows()
