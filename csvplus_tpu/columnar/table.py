@@ -47,24 +47,33 @@ def default_device(device: Optional[str] = None):
     return device  # already a jax.Device
 
 
+def _to_bytes_array(values) -> np.ndarray:
+    """UTF-8 encode a sequence/array of str into an 'S' bytes array."""
+    arr = np.asarray(values, dtype=np.str_)
+    return np.char.encode(arr, "utf-8")
+
+
 def encode_strings(values: Sequence[str]) -> "tuple[np.ndarray, np.ndarray]":
     """Dictionary-encode a string column: (sorted unique values, int32 codes).
 
-    ``None`` entries (absent cells) encode as code -1 and do not enter the
-    dictionary.
+    Dictionaries are stored as UTF-8 **bytes** ('S' dtype): numpy bytes
+    comparison is memcmp, i.e. exactly Go's ``strings.Compare`` byte-
+    lexicographic order (csvplus.go:798), and it sidesteps per-entry
+    Python string objects on the ingest path.  ``None`` entries (absent
+    cells) encode as code -1 and do not enter the dictionary.
     """
     arr = np.asarray(values, dtype=object)
     present = np.array([v is not None for v in arr], dtype=bool)
     if present.all():
-        dictionary, codes = np.unique(np.asarray(values, dtype=np.str_), return_inverse=True)
+        dictionary, codes = np.unique(_to_bytes_array(values), return_inverse=True)
         return dictionary, codes.astype(np.int32)
     codes = np.full(len(arr), ABSENT, dtype=np.int32)
     if present.any():
-        present_vals = np.asarray([v for v in arr if v is not None], dtype=np.str_)
+        present_vals = _to_bytes_array([v for v in arr if v is not None])
         dictionary, inv = np.unique(present_vals, return_inverse=True)
         codes[present] = inv.astype(np.int32)
     else:
-        dictionary = np.empty(0, dtype=np.str_)
+        dictionary = np.empty(0, dtype="S1")
     return dictionary, codes
 
 
@@ -72,8 +81,9 @@ def lookup_code(dictionary: np.ndarray, value: str) -> int:
     """Dictionary slot of *value*, or -1 when absent (host binary search)."""
     if dictionary.size == 0:
         return -1
-    i = int(np.searchsorted(dictionary, value))
-    if i < dictionary.size and dictionary[i] == value:
+    key = value.encode("utf-8") if dictionary.dtype.kind == "S" else value
+    i = int(np.searchsorted(dictionary, key))
+    if i < dictionary.size and dictionary[i] == key:
         return i
     return -1
 
@@ -82,9 +92,10 @@ def lookup_code(dictionary: np.ndarray, value: str) -> int:
 class StringColumn:
     """One dictionary-encoded string column."""
 
-    dictionary: np.ndarray  # sorted unique values, host
+    dictionary: np.ndarray  # sorted unique values (UTF-8 'S' bytes), host
     codes: jax.Array  # int32[n] on device; -1 = absent cell
     _has_absent: "bool | None" = None  # lazy cache: any absent cells?
+    _str_dict: "np.ndarray | None" = None  # lazy cache: decoded dictionary
 
     @property
     def has_absent(self) -> bool:
@@ -106,9 +117,21 @@ class StringColumn:
     @classmethod
     def constant(cls, value: str, n: int, device) -> "StringColumn":
         return cls(
-            np.asarray([value], dtype=np.str_),
+            np.asarray([value.encode("utf-8")], dtype="S"),
             jax.device_put(np.zeros(n, dtype=np.int32), device),
         )
+
+    def dictionary_str(self) -> np.ndarray:
+        """The dictionary as python-str values (decoded lazily, cached)."""
+        if self._str_dict is None:
+            d = self.dictionary
+            if d.dtype.kind == "S":
+                self._str_dict = (
+                    np.char.decode(d, "utf-8") if d.size else np.empty(0, np.str_)
+                )
+            else:
+                self._str_dict = d
+        return self._str_dict
 
     def __len__(self) -> int:
         return int(self.codes.shape[0])
@@ -116,14 +139,17 @@ class StringColumn:
     def gather(self, sel) -> "StringColumn":
         """New column of the selected row positions (device gather)."""
         idx = jnp.asarray(sel, dtype=jnp.int32)
-        return StringColumn(self.dictionary, jnp.take(self.codes, idx, axis=0))
+        out = StringColumn(self.dictionary, jnp.take(self.codes, idx, axis=0))
+        out._str_dict = self._str_dict  # dictionary unchanged; keep cache
+        return out
 
     def decode(self) -> List[Optional[str]]:
         """Materialize values on host; absent cells become None."""
         codes = np.asarray(self.codes)
         if self.dictionary.size == 0:
             return [None] * codes.shape[0]
-        vals = self.dictionary[np.clip(codes, 0, self.dictionary.size - 1)]
+        d = self.dictionary_str()
+        vals = d[np.clip(codes, 0, d.size - 1)]
         out = vals.tolist()
         if (codes < 0).any():
             out = [None if c < 0 else v for c, v in zip(codes.tolist(), out)]
@@ -189,6 +215,22 @@ class DeviceTable:
         for name, values in data.items():
             cols[name] = StringColumn.from_values(values, dev)
             nrows = len(values)
+        return cls(cols, nrows, dev)
+
+    @classmethod
+    def from_encoded(
+        cls,
+        data: "Dict[str, tuple[np.ndarray, np.ndarray]]",
+        nrows: int,
+        device=None,
+    ) -> "DeviceTable":
+        """Build from already dictionary-encoded columns
+        ((dictionary, codes) pairs, e.g. the native ingest fast path)."""
+        dev = default_device(device)
+        cols = {
+            name: StringColumn(dictionary, jax.device_put(codes, dev))
+            for name, (dictionary, codes) in data.items()
+        }
         return cls(cols, nrows, dev)
 
     @classmethod

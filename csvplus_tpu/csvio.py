@@ -53,7 +53,28 @@ def parse_records(
     if comment is not None and len(comment) != 1:
         raise ValueError("csv comment char must be a single character")
 
-    readline = stream.readline
+    # Split records strictly at '\n' like Go's csv reader: Python streams
+    # opened with newline='' (and some user-supplied streams) treat a lone
+    # '\r' as a line ending, which would corrupt fields containing bare
+    # carriage returns — re-join such fragments.
+    def _lf_lines():
+        buf = []
+        while True:
+            piece = stream.readline()
+            if piece == "":
+                if buf:
+                    yield "".join(buf)
+                return
+            buf.append(piece)
+            if piece.endswith("\n"):
+                yield "".join(buf)
+                buf = []
+
+    _gen = _lf_lines()
+
+    def readline() -> str:
+        return next(_gen, "")
+
     while True:
         line = readline()
         if line == "":

@@ -1,0 +1,9 @@
+"""Native (C++) runtime components.
+
+The reference has zero native code (SURVEY.md §2), so this layer's
+obligation comes from the rebuild's own needs: the host side of the
+columnar ingest path must keep up with the device side.  ``scanner``
+provides a single-pass zero-copy CSV chunk scanner (g++-compiled, loaded
+via ctypes) that is differential-tested against the pure-Python
+specification in :mod:`csvplus_tpu.csvio`.
+"""

@@ -1,0 +1,222 @@
+// Native CSV chunk scanner.
+//
+// Single-pass byte-level state machine with the same semantics as the
+// Python specification in csvplus_tpu/csvio.py (which mirrors the
+// reference's use of Go encoding/csv, csvplus.go:1091-1097):
+//   - records end at '\n' or "\r\n"; quoted fields may span lines;
+//   - blank lines and comment-prefixed lines are skipped at record start;
+//   - RFC-4180 quoting with "" doubling; without lazy_quotes a bare '"'
+//     in an unquoted field or a stray '"' in a quoted field is an error;
+//   - a trailing delimiter yields an empty last field.
+//
+// Output is COLUMNAR-friendly: no per-record allocations, just flat
+// arrays of field (start, length) into the input buffer.  Fields that
+// need transformation (escaped quotes, normalized line breaks inside
+// quotes) are materialized into a caller-provided scratch buffer and
+// flagged with a negative start: start = -(scratch_offset + 1).
+//
+// Returns the total number of fields parsed, or a negative error code
+// with *err_record set to the 1-based record ordinal.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+enum {
+  CSV_ERR_BARE_QUOTE = -1,  // bare " in non-quoted field
+  CSV_ERR_QUOTE = -2,       // extraneous or missing " in quoted-field
+  CSV_ERR_OVERFLOW = -3,    // caller's arrays too small (should not happen)
+};
+
+int64_t csv_scan(const char* buf, int64_t len, char delim, char comment,
+                 int has_comment, int lazy_quotes, int trim_space,
+                 int64_t* field_starts, int32_t* field_lens,
+                 int32_t* rec_counts, char* scratch, int64_t scratch_cap,
+                 int64_t* scratch_used, int64_t max_fields,
+                 int64_t max_records, int64_t* err_record) {
+  int64_t pos = 0;
+  int64_t nfields = 0;
+  int64_t nrecords = 0;
+  int64_t scr = 0;
+
+  while (pos < len) {
+    // ---- record start: skip blank lines and comment lines ----
+    if (buf[pos] == '\n') { pos += 1; continue; }
+    if (buf[pos] == '\r' && pos + 1 < len && buf[pos + 1] == '\n') {
+      pos += 2; continue;
+    }
+    if (has_comment && buf[pos] == comment) {
+      while (pos < len && buf[pos] != '\n') pos++;
+      if (pos < len) pos++;  // consume '\n'
+      continue;
+    }
+
+    if (nrecords >= max_records) { *err_record = nrecords; return CSV_ERR_OVERFLOW; }
+    int32_t fields_in_rec = 0;
+    bool record_done = false;
+
+    while (!record_done) {
+      // ---- one field ----
+      if (nfields >= max_fields) { *err_record = nrecords + 1; return CSV_ERR_OVERFLOW; }
+      if (trim_space) {
+        while (pos < len && (buf[pos] == ' ' || buf[pos] == '\t')) pos++;
+      }
+
+      if (pos < len && buf[pos] == '"') {
+        // ---- quoted field ----
+        pos++;
+        int64_t seg_start = pos;   // current contiguous segment
+        bool needs_scratch = false;
+        int64_t scr_start = scr;   // scratch offset if transformed
+        int64_t plain_start = pos; // zero-copy range when !needs_scratch
+        int64_t plain_len = 0;
+
+        auto flush_segment = [&](int64_t upto) {
+          // append [seg_start, upto) to scratch
+          int64_t n = upto - seg_start;
+          if (n > 0) {
+            if (scr + n > scratch_cap) n = scratch_cap - scr;  // defensive
+            std::memcpy(scratch + scr, buf + seg_start, n);
+            scr += n;
+          }
+        };
+        auto to_scratch_mode = [&](int64_t upto) {
+          if (!needs_scratch) {
+            needs_scratch = true;
+            scr_start = scr;
+            seg_start = plain_start;
+            flush_segment(upto);
+            seg_start = upto;
+          }
+        };
+
+        for (;;) {
+          if (pos >= len) {
+            // EOF inside quotes
+            if (!lazy_quotes) { *err_record = nrecords + 1; return CSV_ERR_QUOTE; }
+            // the Python spec strips each line's terminator before
+            // scanning, so a terminator right at EOF is not field data
+            int64_t end = pos;
+            if (end > seg_start && buf[end - 1] == '\n') {
+              end--;
+              if (end > seg_start && buf[end - 1] == '\r') end--;
+            }
+            if (needs_scratch) {
+              flush_segment(end);
+              field_starts[nfields] = -(scr_start + 1);
+              field_lens[nfields] = (int32_t)(scr - scr_start);
+            } else {
+              field_starts[nfields] = plain_start;
+              field_lens[nfields] = (int32_t)(end - plain_start);
+            }
+            nfields++; fields_in_rec++;
+            record_done = true;
+            break;
+          }
+          char c = buf[pos];
+          if (c == '"') {
+            if (pos + 1 < len && buf[pos + 1] == '"') {
+              // doubled quote -> literal "
+              to_scratch_mode(pos);
+              flush_segment(pos);  // seg_start..pos (content before quote)
+              if (scr < scratch_cap) scratch[scr++] = '"';
+              pos += 2;
+              seg_start = pos;
+              continue;
+            }
+            // closing quote
+            int64_t content_end = pos;
+            pos++;
+            // NOTE: a lone '\r' at EOF is NOT a terminator (the Python
+            // spec only strips "\r\n" pairs), so '"..."\r<EOF>' is a
+            // stray-quote situation, matching csvio.py.
+            bool at_delim = pos < len && buf[pos] == delim;
+            bool at_lf = pos < len && buf[pos] == '\n';
+            bool at_crlf = pos + 1 < len && buf[pos] == '\r' && buf[pos + 1] == '\n';
+            bool at_eof = pos >= len;
+            if (at_delim || at_lf || at_crlf || at_eof) {
+              if (needs_scratch) {
+                flush_segment(content_end);
+                field_starts[nfields] = -(scr_start + 1);
+                field_lens[nfields] = (int32_t)(scr - scr_start);
+              } else {
+                field_starts[nfields] = plain_start;
+                field_lens[nfields] = (int32_t)(content_end - plain_start);
+              }
+              nfields++; fields_in_rec++;
+              if (at_delim) { pos++; break; }            // next field
+              if (at_lf) { pos++; record_done = true; break; }
+              if (at_crlf) { pos += 2; record_done = true; break; }
+              record_done = true; break;                 // EOF
+            }
+            if (lazy_quotes) {
+              // stray quote kept literally, stay inside quotes
+              to_scratch_mode(content_end);
+              flush_segment(content_end);
+              if (scr < scratch_cap) scratch[scr++] = '"';
+              seg_start = pos;
+              continue;
+            }
+            *err_record = nrecords + 1;
+            return CSV_ERR_QUOTE;
+          }
+          if (c == '\r' && pos + 1 < len && buf[pos + 1] == '\n') {
+            // line break inside quotes normalizes to '\n'
+            to_scratch_mode(pos);
+            flush_segment(pos);
+            if (scr < scratch_cap) scratch[scr++] = '\n';
+            pos += 2;
+            seg_start = pos;
+            continue;
+          }
+          pos++;
+        }
+      } else {
+        // ---- unquoted field ----
+        int64_t start = pos;
+        while (pos < len && buf[pos] != delim && buf[pos] != '\n') {
+          if (buf[pos] == '"' && !lazy_quotes) {
+            *err_record = nrecords + 1;
+            return CSV_ERR_BARE_QUOTE;
+          }
+          pos++;
+        }
+        int64_t end = pos;
+        // strip the '\r' of a "\r\n" terminator only — a lone trailing
+        // '\r' at EOF is field data (csvio._strip_eol semantics)
+        bool at_nl = pos < len && buf[pos] == '\n';
+        if (at_nl && end > start && buf[end - 1] == '\r') end--;
+        field_starts[nfields] = start;
+        field_lens[nfields] = (int32_t)(end - start);
+        nfields++; fields_in_rec++;
+        if (pos < len && buf[pos] == delim) { pos++; continue; }  // next field
+        if (pos < len) pos++;  // consume '\n'
+        record_done = true;
+      }
+    }
+
+    rec_counts[nrecords++] = fields_in_rec;
+  }
+
+  *scratch_used = scr;
+  *err_record = nrecords;
+  return nfields;
+}
+
+// how many records were produced before an error / at success is carried
+// via err_record; a second entry point reports the record count for
+// convenience when pre-sizing is needed.
+int64_t csv_count_bounds(const char* buf, int64_t len, char delim,
+                         int64_t* max_fields_out, int64_t* max_records_out) {
+  int64_t d = 0, nl = 0;
+  for (int64_t i = 0; i < len; i++) {
+    if (buf[i] == delim) d++;
+    else if (buf[i] == '\n') nl++;
+  }
+  *max_fields_out = d + nl + 2;
+  *max_records_out = nl + 2;
+  return 0;
+}
+
+}  // extern "C"

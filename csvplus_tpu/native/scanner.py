@@ -1,0 +1,343 @@
+"""ctypes binding + build for the native CSV scanner.
+
+The shared object is compiled on first use with g++ -O3 into the package
+directory (cached by source mtime).  If the toolchain is unavailable the
+import raises and callers fall back to the Python parser — behavior is
+identical either way (differential-tested), only throughput differs.
+
+``read_columns_native`` is the columnar ingest fast path used by
+:func:`csvplus_tpu.columnar.ingest.reader_to_device`: it parses the whole
+file in one native pass and materializes Python strings ONLY for the
+columns the header policy selects.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..csvio import ERR_BARE_QUOTE, ERR_FIELD_COUNT, ERR_QUOTE
+from ..errors import DataSourceError
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "scanner.cpp")
+_SO = os.path.join(_HERE, "_scanner.so")
+_lock = threading.Lock()
+_lib = None
+
+_ERR_MSG = {-1: ERR_BARE_QUOTE, -2: ERR_QUOTE, -3: "native scanner overflow"}
+
+
+def _build() -> str:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    tmp = f"{_SO}.{os.getpid()}.tmp"  # per-process: no concurrent clobber
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+        )
+    except (OSError, subprocess.CalledProcessError) as e:
+        # surface as ImportError so ingest falls back to the Python parser
+        raise ImportError(f"native scanner build failed: {e}") from e
+    os.replace(tmp, _SO)
+    return _SO
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        try:
+            lib = ctypes.CDLL(_build())
+        except OSError as e:
+            # stale/foreign cached .so (other platform, corrupt build):
+            # rebuild once from source, else surface as ImportError so
+            # callers fall back to the Python parser
+            try:
+                os.remove(_SO)
+                lib = ctypes.CDLL(_build())
+            except (OSError, ImportError) as e2:
+                raise ImportError(f"native scanner unusable: {e2}") from e
+        lib.csv_count_bounds.restype = ctypes.c_int64
+        lib.csv_count_bounds.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_char,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.csv_scan.restype = ctypes.c_int64
+        lib.csv_scan.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_char,
+            ctypes.c_char,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        _lib = lib
+        return lib
+
+
+def scan_bytes(
+    data: bytes,
+    delimiter: str = ",",
+    comment: Optional[str] = None,
+    lazy_quotes: bool = False,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, bytes]:
+    """Native scan: (field_starts, field_lens, rec_counts, scratch).
+
+    field_starts < 0 index the scratch buffer at -(start+1); record
+    ordinals for errors are 1-based like the reference's row numbers.
+    """
+    lib = _load()
+    n = len(data)
+    max_fields = ctypes.c_int64(0)
+    max_records = ctypes.c_int64(0)
+    lib.csv_count_bounds(
+        data,
+        n,
+        delimiter.encode("utf-8"),
+        ctypes.byref(max_fields),
+        ctypes.byref(max_records),
+    )
+    mf, mr = max_fields.value, max_records.value
+    starts = np.empty(mf, dtype=np.int64)
+    lens = np.empty(mf, dtype=np.int32)
+    counts = np.empty(mr, dtype=np.int32)
+    scratch = ctypes.create_string_buffer(max(n, 1))
+    scratch_used = ctypes.c_int64(0)
+    err_record = ctypes.c_int64(0)
+
+    rc = lib.csv_scan(
+        data,
+        n,
+        delimiter.encode("utf-8"),
+        (comment or "\x00").encode("utf-8")[0:1],
+        1 if comment else 0,
+        1 if lazy_quotes else 0,
+        0,  # trim handled by the Python fallback (unicode semantics)
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        scratch,
+        len(scratch),
+        ctypes.byref(scratch_used),
+        mf,
+        mr,
+        ctypes.byref(err_record),
+    )
+    if rc < 0:
+        raise DataSourceError(int(err_record.value), _ERR_MSG[int(rc)])
+    nrec = int(err_record.value)
+    # nfields = rc; trim arrays
+    total = int(rc)
+    return starts[:total], lens[:total], counts[:nrec], scratch.raw[: scratch_used.value]
+
+
+def _field_str(data: bytes, scratch: bytes, start: int, length: int) -> str:
+    if start < 0:
+        s = -start - 1
+        return scratch[s : s + length].decode("utf-8")
+    return data[start : start + length].decode("utf-8")
+
+
+_VEC_MAX_FIELD_LEN = 256  # longer fields fall back to per-field strings
+
+
+def encode_fields_vectorized(
+    combined: np.ndarray, starts: np.ndarray, lens: np.ndarray
+):
+    """Dictionary-encode a column directly from (start, len) offsets with
+    zero per-field Python objects.
+
+    Gathers every field into a NUL-padded (n, L) byte matrix, views rows
+    as fixed-width scalars and runs ``np.unique`` — byte order on padded
+    UTF-8 equals code-point order (no field contains NUL; caller checks),
+    so the resulting codes are order-preserving exactly like
+    :func:`csvplus_tpu.columnar.table.encode_strings`.
+
+    Returns (dictionary of np.str_, int32 codes) or None when a field is
+    too long for the padded-matrix approach.
+    """
+    n = starts.shape[0]
+    if n == 0:
+        return np.empty(0, dtype="S1"), np.empty(0, dtype=np.int32)
+    L = int(lens.max()) if n else 0
+    if L > _VEC_MAX_FIELD_LEN:
+        return None
+    L = max(L, 1)
+    idx = starts[:, None] + np.arange(L, dtype=np.int64)[None, :]
+    mask = np.arange(L, dtype=np.int32)[None, :] < lens[:, None]
+    mat = np.where(mask, combined[np.minimum(idx, combined.shape[0] - 1)], 0).astype(
+        np.uint8
+    )
+    if L <= 8:
+        # pack padded bytes big-endian into uint64: integer order equals
+        # byte order, and np.unique on a native scalar dtype is fast
+        shifts = (1 << (8 * np.arange(7, 7 - L, -1, dtype=np.uint64))).astype(
+            np.uint64
+        )
+        packed = mat.astype(np.uint64) @ shifts
+        uniq64, codes = np.unique(packed, return_inverse=True)
+        back = (8 * np.arange(7, 7 - L, -1, dtype=np.int64)).astype(np.uint64)
+        ub = ((uniq64[:, None] >> back[None, :]) & np.uint64(0xFF)).astype(np.uint8)
+        dictionary = np.ascontiguousarray(ub).view(f"S{L}").ravel()
+        return dictionary, codes.ravel().astype(np.int32)
+    as_void = np.ascontiguousarray(mat).view([("v", f"V{L}")])["v"].ravel()
+    uniq, codes = np.unique(as_void, return_inverse=True)
+    # keep the dictionary as UTF-8 bytes; sinks decode lazily
+    dictionary = uniq.view(f"S{L}").ravel()
+    return dictionary, codes.ravel().astype(np.int32)
+
+
+def _column_positions(data_counts, field_offset, header, rec_base, pad_allowed):
+    """Per-column (positions, ok-mask) into the flat field arrays, with the
+    shared column-not-found policy (csvplus.go:1121-1130)."""
+    rec_offsets = np.zeros(data_counts.shape[0] + 1, dtype=np.int64)
+    np.cumsum(data_counts, out=rec_offsets[1:])
+    rec_offsets += field_offset
+    for name in header:
+        idx = header[name]
+        pos = rec_offsets[:-1] + idx
+        ok = data_counts > idx
+        if not ok.all() and not pad_allowed:
+            first_bad = int(np.flatnonzero(~ok)[0]) + rec_base
+            raise DataSourceError(first_bad, f'column not found: "{name}" ({idx})')
+        yield name, pos, ok
+
+
+def read_encoded_columns_native(reader, path: str):
+    """Columnar ingest fast path: parse natively AND dictionary-encode
+    each selected column vectorized — no per-cell Python strings.
+
+    Returns (names, {name: (dictionary, codes)}) or None to fall back.
+    """
+    scanned = _scan_for_reader(reader, path)
+    if scanned is None:
+        return None
+    data, starts, lens, counts, scratch, header, rec_base, field_offset = scanned
+    if b"\x00" in data:  # NUL would be ambiguous with padding
+        return None
+
+    data_counts = counts[1:] if rec_base == 2 else counts
+
+    # combined buffer: scratch fields get offsets past the input data
+    combined = np.frombuffer(data + scratch, dtype=np.uint8)
+    base = len(data)
+    abs_starts = np.where(starts >= 0, starts, base + (-starts - 1))
+
+    out = {}
+    pad_allowed = reader._num_fields < 0
+    for name, pos, ok in _column_positions(
+        data_counts, field_offset, header, rec_base, pad_allowed
+    ):
+        col_starts = np.where(ok, abs_starts[np.where(ok, pos, 0)], 0)
+        col_lens = np.where(ok, lens[np.where(ok, pos, 0)], 0)
+        enc = encode_fields_vectorized(combined, col_starts, col_lens.astype(np.int32))
+        if enc is None:
+            return None  # long fields: let the string path handle it
+        out[name] = enc
+    return list(header), out
+
+
+def _scan_for_reader(reader, path: str):
+    """Shared native-scan + header-policy resolution for both fast paths."""
+    if reader._trim_leading_space:
+        return None
+    if len(reader._delimiter.encode("utf-8")) != 1:
+        return None
+    if reader._comment is not None and len(reader._comment.encode("utf-8")) != 1:
+        return None
+
+    with open(path, "rb") as f:
+        data = f.read()
+
+    starts, lens, counts, scratch = scan_bytes(
+        data,
+        delimiter=reader._delimiter,
+        comment=reader._comment,
+        lazy_quotes=reader._lazy_quotes,
+    )
+
+    nrec = counts.shape[0]
+    expected = reader._num_fields
+    if reader._header_from_first_row:
+        if nrec == 0:
+            raise DataSourceError(1, "EOF")
+        first_n = int(counts[0])
+        if expected == 0:
+            expected = first_n
+        elif expected > 0 and first_n != expected:
+            raise DataSourceError(1, ERR_FIELD_COUNT)
+        first = [
+            _field_str(data, scratch, int(starts[i]), int(lens[i]))
+            for i in range(first_n)
+        ]
+        header = reader._make_header(first, 1)
+        rec_base = 2
+        field_offset = first_n
+        data_counts = counts[1:]
+    else:
+        header = dict(reader._header or {})
+        rec_base = 1
+        field_offset = 0
+        data_counts = counts
+
+    if reader._num_fields >= 0 and data_counts.shape[0]:
+        if expected == 0:
+            expected = int(data_counts[0])
+        bad = np.flatnonzero(data_counts != expected)
+        if bad.size:
+            raise DataSourceError(int(bad[0]) + rec_base, ERR_FIELD_COUNT)
+
+    return data, starts, lens, counts, scratch, header, rec_base, field_offset
+
+
+def read_columns_native(reader, path: str):
+    """Columnar read honoring the Reader's header/field-count policies.
+
+    Returns (names, {name: [values]}) like Reader.read_columns, or None
+    when this reader's configuration needs the Python path.  Only the
+    columns the header policy selects are ever materialized as strings.
+    """
+    scanned = _scan_for_reader(reader, path)
+    if scanned is None:
+        return None
+    data, starts, lens, counts, scratch, header, rec_base, field_offset = scanned
+
+    data_counts = counts[1:] if rec_base == 2 else counts
+    out: Dict[str, List[str]] = {}
+    pad_allowed = reader._num_fields < 0
+    for name, pos, ok in _column_positions(
+        data_counts, field_offset, header, rec_base, pad_allowed
+    ):
+        col_starts = starts[np.where(ok, pos, 0)]
+        col_lens = lens[np.where(ok, pos, 0)]
+        ok_list = ok.tolist()
+        values = [
+            _field_str(data, scratch, int(s), int(l)) if o else ""
+            for s, l, o in zip(col_starts.tolist(), col_lens.tolist(), ok_list)
+        ]
+        out[name] = values
+    return list(header), out

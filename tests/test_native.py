@@ -1,0 +1,247 @@
+"""Native C++ scanner: differential tests against the Python spec
+(csvplus_tpu/csvio.py), including hypothesis-generated CSVs."""
+
+import io
+
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from csvplus_tpu import DataSourceError, Take, from_file
+from csvplus_tpu.csvio import CsvParseError, parse_records
+
+native = pytest.importorskip("csvplus_tpu.native.scanner")
+
+
+def native_records(text: str, **kw):
+    """Reassemble full records from the native flat arrays."""
+    data = text.encode("utf-8")
+    starts, lens, counts, scratch = native.scan_bytes(data, **kw)
+    out, f = [], 0
+    for c in counts.tolist():
+        rec = []
+        for i in range(f, f + c):
+            s, l = int(starts[i]), int(lens[i])
+            rec.append(
+                scratch[-s - 1 : -s - 1 + l].decode("utf-8")
+                if s < 0
+                else data[s : s + l].decode("utf-8")
+            )
+        out.append(rec)
+        f += c
+    return out
+
+
+def python_records(text: str, **kw):
+    return list(parse_records(io.StringIO(text), **kw))
+
+
+CASES = [
+    "a,b,c\n1,2,3\n",
+    "a,b\n1,2",  # no trailing newline
+    "x\r\ny\r\n",  # CRLF
+    '"quoted,comma",2\n',
+    '"say ""hi""",2\n',
+    '"multi\nline",2\n',
+    '"multi\r\nline",2\n',
+    "1,,3\n",  # empty middle
+    "1,2,\n",  # trailing delimiter
+    "\n\n1,2\n\n",  # blank lines
+    "",  # empty input
+    "lone\rcr,2\n",  # \r inside field is data
+    'trail\r',  # lone \r at EOF is data
+    '"q"\n',
+    'a,"",b\n',
+]
+
+
+@pytest.mark.parametrize("text", CASES)
+def test_native_matches_python(text):
+    assert native_records(text) == python_records(text)
+
+
+@pytest.mark.parametrize(
+    "text", ["# c\na,b\n# d\n1,2\n", "#only\n", "x#notcomment,1\n"]
+)
+def test_native_comments(text):
+    assert native_records(text, comment="#") == python_records(text, comment="#")
+
+
+@pytest.mark.parametrize("text", ['x"y,2\n', '"x"y,2\n', '"never closed\n'])
+def test_native_errors_match(text):
+    with pytest.raises(CsvParseError) as pe:
+        python_records(text)
+    with pytest.raises(DataSourceError) as ne:
+        native_records(text)
+    assert str(pe.value) in str(ne.value)
+
+
+@pytest.mark.parametrize("text", ['x"y,2\n', '"x"y",2\n', '"never closed\n'])
+def test_native_lazy_quotes_match(text):
+    assert native_records(text, lazy_quotes=True) == python_records(
+        text, lazy_quotes=True
+    )
+
+
+# hypothesis: random field content through quoting round trips identically
+_field = st.text(
+    alphabet=st.characters(
+        codec="utf-8", exclude_characters="\x00"
+    ),
+    max_size=12,
+)
+
+
+def _to_csv(rows):
+    def q(f):
+        if any(c in f for c in ',"\r\n') or f.startswith(" "):
+            return '"' + f.replace('"', '""') + '"'
+        return f
+
+    return "".join(",".join(q(f) for f in r) + "\n" for r in rows)
+
+
+@settings(max_examples=150, deadline=None)
+@given(
+    st.lists(
+        st.lists(_field, min_size=1, max_size=5),
+        min_size=0,
+        max_size=8,
+    )
+)
+def test_native_hypothesis_roundtrip(rows):
+    text = _to_csv(rows)
+    assert native_records(text) == python_records(text)
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.text(max_size=60))
+def test_native_hypothesis_arbitrary_text(text):
+    """Arbitrary (possibly malformed) input: both parsers agree on either
+    the records or the error."""
+    try:
+        want = python_records(text)
+    except CsvParseError as e:
+        with pytest.raises(DataSourceError) as ne:
+            native_records(text)
+        assert str(e) in str(ne.value)
+        return
+    assert native_records(text) == want
+
+
+def test_read_columns_native_matches_reader(people_csv, orders_csv):
+    for path in (people_csv, orders_csv):
+        r1 = from_file(path)
+        want = r1.read_columns()
+        got = native.read_columns_native(from_file(path), path)
+        assert got is not None
+        assert got[0] == want[0]
+        assert got[1] == want[1]
+
+
+def test_read_columns_native_select_columns(people_csv):
+    r = from_file(people_csv).select_columns("id", "born")
+    want = r.read_columns()
+    got = native.read_columns_native(
+        from_file(people_csv).select_columns("id", "born"), people_csv
+    )
+    assert got == want
+
+
+def test_read_columns_native_field_count_error(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("a,b\n1,2\n1,2,3\n")
+    with pytest.raises(DataSourceError) as e:
+        native.read_columns_native(from_file(str(p)), str(p))
+    assert str(e.value) == "row 3: wrong number of fields"
+
+
+def test_ingest_uses_native_scanner(people_csv):
+    """OnDevice ingest goes through the native fast path for files."""
+    from csvplus_tpu.columnar import ingest
+
+    names, data = ingest._read_columns_fast(from_file(people_csv))
+    assert names and len(data["id"]) == 120
+
+
+# -- encoded fast-path tier: direct differential coverage -----------------
+
+
+def _encoded_to_strings(enc):
+    import numpy as np
+
+    names, data = enc
+    out = {}
+    for name in names:
+        d, c = data[name]
+        ds = np.char.decode(d, "utf-8") if d.dtype.kind == "S" else d
+        out[name] = ds[c].tolist()
+    return names, out
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "t.csv"
+    p.write_bytes(text.encode("utf-8"))
+    return str(p)
+
+
+@pytest.mark.parametrize(
+    "text",
+    [
+        'a,b\n"esc ""q""",2\n"multi\nline",3\nplain,4\n',  # scratch fields
+        "a,b\n" + "x" * 300 + ",1\n",  # > vectorized cap -> None (fallback)
+        "a,b\nZoë,Zürich\n",  # utf-8 multi-byte
+        "a,b\n" + "y" * 12 + ",1\n" + "z" * 9 + ",2\n",  # 8 < L <= 16 void tier
+    ],
+)
+def test_encoded_tier_matches_reader(tmp_path, text):
+    from csvplus_tpu import from_file
+
+    path = _write(tmp_path, text)
+    enc = native.read_encoded_columns_native(from_file(path), path)
+    want_names, want = from_file(path).read_columns()
+    if enc is None:
+        return  # documented fallback (long fields); string tier covers it
+    names, got = _encoded_to_strings(enc)
+    assert names == want_names
+    assert got == want
+
+
+def test_encoded_tier_padded_missing_columns(tmp_path):
+    from csvplus_tpu import from_file
+
+    path = _write(tmp_path, "1,2,3\n4\n")
+    mk = lambda: from_file(path).assume_header({"x": 0, "z": 2}).num_fields_any()
+    enc = native.read_encoded_columns_native(mk(), path)
+    assert enc is not None
+    _, got = _encoded_to_strings(enc)
+    assert got == mk().read_columns()[1]
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    st.lists(
+        st.lists(_field, min_size=2, max_size=4),
+        min_size=1,
+        max_size=8,
+    )
+)
+def test_encoded_tier_hypothesis(tmp_path_factory, rows):
+    """The vectorized-encode tier decodes to exactly the Reader's output
+    for arbitrary quoted content (scratch fields, unicode, empties)."""
+    from csvplus_tpu import from_file
+
+    width = max(len(r) for r in rows)
+    rows = [r + [""] * (width - len(r)) for r in rows]
+    header = [f"c{i}" for i in range(width)]
+    text = _to_csv([header] + rows)
+    if "\x00" in text:
+        return
+    p = tmp_path_factory.mktemp("enc") / "h.csv"
+    p.write_bytes(text.encode("utf-8"))
+    enc = native.read_encoded_columns_native(from_file(str(p)), str(p))
+    want_names, want = from_file(str(p)).read_columns()
+    if enc is None:
+        return
+    names, got = _encoded_to_strings(enc)
+    assert got == want
