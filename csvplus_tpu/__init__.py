@@ -41,6 +41,7 @@ from .index import Index, create_index, create_unique_index, load_index
 from .predicates import All, Any_, Like, Not, Predicate
 from .exprs import Rename, SetValue, Update
 from . import plan
+from .utils import telemetry, profile_to
 
 # Go-style API aliases (reference names; BASELINE.json exercises these)
 Take = take
@@ -86,6 +87,8 @@ __all__ = [
     # helpers
     "merge_rows",
     "plan",
+    "telemetry",
+    "profile_to",
     # Go-style aliases
     "Take",
     "TakeRows",

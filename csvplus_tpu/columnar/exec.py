@@ -72,10 +72,11 @@ def _linearize(node: P.PlanNode) -> List[P.PlanNode]:
 
 
 def execute_plan(root: P.PlanNode) -> DeviceTable:
-    """Run the plan and return the resulting materialized DeviceTable."""
-    from ..ops.filter import UnsupportedPredicate, build_mask
-    from ..ops import join as J
+    """Run the plan and return the resulting materialized DeviceTable.
 
+    With :data:`csvplus_tpu.utils.telemetry` enabled, every stage records
+    (rows in, rows out, wall time) and shows as a named range in device
+    profiles."""
     stages = _linearize(root)
     scan = stages[0]
     assert isinstance(scan, P.Scan)
@@ -87,61 +88,75 @@ def execute_plan(root: P.PlanNode) -> DeviceTable:
         table.nrows,
     )
 
+    from ..utils.observe import telemetry
+
     for node in stages[1:]:
-        if isinstance(node, P.Filter):
-            nrows = _full_len(view)
-            try:
-                mask = build_mask(view.cols, nrows, node.pred)
-            except UnsupportedPredicate as e:
-                raise UnsupportedPlan(str(e)) from e
-            mask_np = np.asarray(mask)
-            view.sel = view.sel[mask_np[view.sel]]
-        elif isinstance(node, P.Top):
-            view.sel = view.sel[: node.n]
-        elif isinstance(node, P.DropRows):
-            view.sel = view.sel[node.n :]
-        elif isinstance(node, P.SelectCols):
-            _apply_select(view, node.columns)
-        elif isinstance(node, P.DropCols):
-            view.cols = {
-                n: c for n, c in view.cols.items() if n not in set(node.columns)
-            }
-        elif isinstance(node, P.MapExpr):
-            _apply_map(view, node.expr)
-        elif isinstance(node, P.Join):
-            dev_index = node.index.device_table
-            if dev_index is None or not dev_index.supported:
-                raise UnsupportedPlan("join build side has no packed device index")
-            stream = view.materialize()
-            try:
-                joined = J.join_tables(stream, dev_index, list(node.columns))
-            except MissingColumnError as e:
-                raise DataSourceError(0, e) from e
-            view = _View(
-                dict(joined.columns),
-                np.arange(joined.nrows, dtype=np.int64),
-                joined.device,
-                joined.nrows,
-            )
-        elif isinstance(node, P.Except):
-            dev_index = node.index.device_table
-            if dev_index is None or not dev_index.supported:
-                raise UnsupportedPlan("except build side has no packed device index")
-            stream = view.materialize()
-            try:
-                keep = J.except_mask(stream, dev_index, list(node.columns))
-            except MissingColumnError as e:
-                raise DataSourceError(0, e) from e
-            view = _View(
-                dict(stream.columns),
-                np.flatnonzero(keep).astype(np.int64),
-                stream.device,
-                stream.nrows,
-            )
-        else:
-            raise UnsupportedPlan(f"no device lowering for {type(node).__name__}")
+        with telemetry.stage(type(node).__name__, int(view.sel.shape[0])) as _t:
+            view = _exec_stage(view, node)
+            _t["rows_out"] = int(view.sel.shape[0])
 
     return view.materialize()
+
+
+def _exec_stage(view: "_View", node: P.PlanNode) -> "_View":
+    """Execute one plan node against the view (mutating or replacing it)."""
+    from ..ops.filter import UnsupportedPredicate, build_mask
+    from ..ops import join as J
+
+    if isinstance(node, P.Filter):
+        nrows = _full_len(view)
+        try:
+            mask = build_mask(view.cols, nrows, node.pred)
+        except UnsupportedPredicate as e:
+            raise UnsupportedPlan(str(e)) from e
+        mask_np = np.asarray(mask)
+        view.sel = view.sel[mask_np[view.sel]]
+    elif isinstance(node, P.Top):
+        view.sel = view.sel[: node.n]
+    elif isinstance(node, P.DropRows):
+        view.sel = view.sel[node.n :]
+    elif isinstance(node, P.SelectCols):
+        _apply_select(view, node.columns)
+    elif isinstance(node, P.DropCols):
+        view.cols = {
+            n: c for n, c in view.cols.items() if n not in set(node.columns)
+        }
+    elif isinstance(node, P.MapExpr):
+        _apply_map(view, node.expr)
+    elif isinstance(node, P.Join):
+        dev_index = node.index.device_table
+        if dev_index is None or not dev_index.supported:
+            raise UnsupportedPlan("join build side has no packed device index")
+        stream = view.materialize()
+        try:
+            joined = J.join_tables(stream, dev_index, list(node.columns))
+        except MissingColumnError as e:
+            raise DataSourceError(0, e) from e
+        view = _View(
+            dict(joined.columns),
+            np.arange(joined.nrows, dtype=np.int64),
+            joined.device,
+            joined.nrows,
+        )
+    elif isinstance(node, P.Except):
+        dev_index = node.index.device_table
+        if dev_index is None or not dev_index.supported:
+            raise UnsupportedPlan("except build side has no packed device index")
+        stream = view.materialize()
+        try:
+            keep = J.except_mask(stream, dev_index, list(node.columns))
+        except MissingColumnError as e:
+            raise DataSourceError(0, e) from e
+        view = _View(
+            dict(stream.columns),
+            np.flatnonzero(keep).astype(np.int64),
+            stream.device,
+            stream.nrows,
+        )
+    else:
+        raise UnsupportedPlan(f"no device lowering for {type(node).__name__}")
+
+    return view
 
 
 def _full_len(view: _View) -> int:

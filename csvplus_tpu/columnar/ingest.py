@@ -38,25 +38,30 @@ def reader_to_device(reader, device: str = "tpu", **opts) -> DataSource:
     per-cell Python objects) > native scan + Python strings > pure-Python
     parse.  All three are differential-tested to identical results.
     """
+    from ..utils.observe import telemetry
+
     path = getattr(reader, "_path", None)
     if path is not None:
         try:
             from ..native import scanner
 
-            enc = scanner.read_encoded_columns_native(reader, path)
+            with telemetry.stage("ingest:native-encoded", 0) as _t:
+                enc = scanner.read_encoded_columns_native(reader, path)
+                if enc is not None:
+                    names, data = enc
+                    nrows = data[names[0]][1].shape[0] if names else 0
+                    table = DeviceTable.from_encoded(
+                        {n: data[n] for n in names}, nrows, device=device
+                    )
+                    _t["rows_out"] = nrows
             if enc is not None:
-                names, data = enc
-                nrows = (
-                    data[names[0]][1].shape[0] if names else 0
-                )
-                table = DeviceTable.from_encoded(
-                    {n: data[n] for n in names}, nrows, device=device
-                )
                 return source_from_table(table)
         except ImportError:
             pass
-    names, data = _read_columns_fast(reader, **opts)
-    table = DeviceTable.from_pylists({n: data[n] for n in names}, device=device)
+    with telemetry.stage("ingest:python", 0) as _t:
+        names, data = _read_columns_fast(reader, **opts)
+        table = DeviceTable.from_pylists({n: data[n] for n in names}, device=device)
+        _t["rows_out"] = table.nrows
     return source_from_table(table)
 
 

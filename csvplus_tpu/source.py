@@ -344,6 +344,25 @@ class DataSource:
         from .plan import except_plan
         return _make(run, except_plan(self.plan, index, cols))
 
+    # -- device migration --------------------------------------------------
+
+    def on_device(self, device: str = "tpu") -> "DataSource":
+        """Materialize this source into an HBM-resident columnar table and
+        return a plan-capable DataSource over it.
+
+        The device-native entry point is ``FromFile(...).OnDevice()``
+        (which parses straight into columns); this method is the general
+        form for any host source — it streams the rows once, columnarizes
+        (heterogeneous schemas allowed; missing cells stay absent), and
+        subsequent symbolic stages run as device kernels.
+        """
+        from .columnar.ingest import source_from_table
+        from .columnar.table import DeviceTable
+
+        return source_from_table(DeviceTable.from_rows(self.to_rows(), device=device))
+
+    OnDevice = on_device
+
     # -- sinks (implemented in sinks.py) -----------------------------------
 
     def to_csv(self, out, *columns: str) -> None:

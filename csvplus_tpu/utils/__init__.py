@@ -1,0 +1,5 @@
+"""Cross-cutting utilities: observability, profiling, timers."""
+
+from .observe import StageRecord, Telemetry, telemetry, profile_to
+
+__all__ = ["StageRecord", "Telemetry", "telemetry", "profile_to"]

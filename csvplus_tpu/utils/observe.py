@@ -1,0 +1,109 @@
+"""Observability: per-stage pipeline telemetry + JAX profiler hooks.
+
+The reference has no instrumentation at all (SURVEY.md §5: the only
+observability is error line numbers).  A device framework needs more:
+
+* :data:`telemetry` — an opt-in collector of per-stage row counts and
+  wall times from the device plan executor and the columnar ingest; cheap
+  enough to leave on in production pipelines (a few host ops per stage,
+  never per row);
+* :func:`profile_to` — context manager around ``jax.profiler.trace`` so a
+  whole pipeline run can be captured for XProf/Perfetto;
+* ``TraceAnnotation`` pass-through so executor stages show up as named
+  ranges inside device traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional
+
+
+@dataclass
+class StageRecord:
+    """One executed pipeline stage."""
+
+    stage: str  # e.g. "Filter", "Join", "ingest:native-encoded"
+    rows_in: int
+    rows_out: int
+    seconds: float
+
+    def __str__(self) -> str:
+        return (
+            f"{self.stage:<24} {self.rows_in:>12} -> {self.rows_out:<12}"
+            f" {self.seconds * 1e3:9.2f} ms"
+        )
+
+
+@dataclass
+class Telemetry:
+    """Opt-in pipeline statistics collector (process-global singleton)."""
+
+    enabled: bool = False
+    records: List[StageRecord] = field(default_factory=list)
+
+    def reset(self) -> None:
+        self.records.clear()
+
+    @contextlib.contextmanager
+    def collect(self) -> Iterator[List[StageRecord]]:
+        """Enable collection within a scope; yields the record list."""
+        prev = self.enabled
+        self.enabled = True
+        self.reset()
+        try:
+            yield self.records
+        finally:
+            self.enabled = prev
+
+    @contextlib.contextmanager
+    def stage(self, name: str, rows_in: int) -> Iterator[dict]:
+        """Record one stage; the body may set ``out['rows_out']``."""
+        if not self.enabled:
+            yield {}
+            return
+        out: dict = {}
+        t0 = time.perf_counter()
+        with _trace_annotation(f"csvplus:{name}"):
+            yield out
+        self.records.append(
+            StageRecord(
+                stage=name,
+                rows_in=rows_in,
+                rows_out=int(out.get("rows_out", rows_in)),
+                seconds=time.perf_counter() - t0,
+            )
+        )
+
+    def report(self) -> str:
+        head = f"{'stage':<24} {'rows in':>12}    {'rows out':<12} {'time':>9}"
+        return "\n".join([head] + [str(r) for r in self.records])
+
+
+telemetry = Telemetry()
+
+
+@contextlib.contextmanager
+def _trace_annotation(name: str):
+    try:
+        import jax.profiler
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    except Exception:  # profiler unavailable: annotations are best-effort
+        yield
+
+
+@contextlib.contextmanager
+def profile_to(log_dir: str):
+    """Capture a JAX device trace of the enclosed pipeline run for
+    XProf/Perfetto (``jax.profiler.trace``)."""
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

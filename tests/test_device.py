@@ -371,3 +371,31 @@ def test_filter_after_dropping_all_columns(dev_people, host_people):
         Like({"a": "x"})
     )
     same(gone(dev_people).to_rows(), gone(host_people).to_rows())
+
+
+def test_datasource_on_device_general(host_people):
+    """Any host source can migrate to the device mid-chain."""
+    dev = host_people.filter(lambda r: r["name"] != "Jack").on_device("cpu")
+    assert dev.plan is not None
+    got = dev.filter(Like({"surname": "Smith"})).to_rows()
+    want = (
+        host_people.filter(lambda r: r["name"] != "Jack")
+        .filter(Like({"surname": "Smith"}))
+        .to_rows()
+    )
+    assert got == want
+
+
+def test_telemetry_collects_stages(dev_people):
+    from csvplus_tpu import telemetry
+
+    with telemetry.collect() as records:
+        dev_people.filter(Like({"name": "Amelia"})).select_columns(
+            "id", "name"
+        ).to_rows()
+    stages = [r.stage for r in records]
+    assert "Filter" in stages and "SelectCols" in stages
+    f = records[stages.index("Filter")]
+    assert f.rows_in == 120 and f.rows_out == 12
+    assert telemetry.report()
+    assert not telemetry.enabled  # scope ended
