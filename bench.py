@@ -137,7 +137,37 @@ def _bench_host(data, sample: int) -> float:
     return count / dt
 
 
+def _ensure_live_backend() -> None:
+    """Guard against a wedged accelerator tunnel: probe JAX backend init
+    in a subprocess with a deadline; on failure re-exec this benchmark in
+    a hermetic CPU environment so the driver ALWAYS gets its JSON line.
+    """
+    import subprocess
+
+    if os.environ.get("CSVPLUS_BENCH_HERMETIC") == "1":
+        return
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=int(os.environ.get("CSVPLUS_BENCH_PROBE_TIMEOUT", 180)),
+            capture_output=True,
+        )
+        if probe.returncode == 0:
+            return  # backend healthy
+    except subprocess.TimeoutExpired:
+        pass
+    sys.stderr.write(
+        "bench: accelerator backend unreachable; falling back to CPU\n"
+    )
+    env = dict(os.environ)
+    env["CSVPLUS_BENCH_HERMETIC"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
 def main() -> None:
+    _ensure_live_backend()
     n_orders = int(os.environ.get("CSVPLUS_BENCH_ROWS", 2_000_000))
     n_cust = int(os.environ.get("CSVPLUS_BENCH_CUSTOMERS", 100_000))
     n_prod = int(os.environ.get("CSVPLUS_BENCH_PRODUCTS", 1_000))

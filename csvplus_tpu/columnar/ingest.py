@@ -54,6 +54,8 @@ def reader_to_device(reader, device: str = "tpu", **opts) -> DataSource:
                         {n: data[n] for n in names}, nrows, device=device
                     )
                     _t["rows_out"] = nrows
+                else:
+                    _t["discard"] = True  # tier declined; python tier records
             if enc is not None:
                 return source_from_table(table)
         except ImportError:

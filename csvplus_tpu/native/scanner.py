@@ -70,7 +70,7 @@ def _load():
                 raise ImportError(f"native scanner unusable: {e2}") from e
         lib.csv_count_bounds.restype = ctypes.c_int64
         lib.csv_count_bounds.argtypes = [
-            ctypes.c_char_p,
+            ctypes.c_void_p,
             ctypes.c_int64,
             ctypes.c_char,
             ctypes.POINTER(ctypes.c_int64),
@@ -78,7 +78,7 @@ def _load():
         ]
         lib.csv_scan.restype = ctypes.c_int64
         lib.csv_scan.argtypes = [
-            ctypes.c_char_p,
+            ctypes.c_void_p,
             ctypes.c_int64,
             ctypes.c_char,
             ctypes.c_char,
@@ -104,18 +104,23 @@ def scan_bytes(
     delimiter: str = ",",
     comment: Optional[str] = None,
     lazy_quotes: bool = False,
+    offset: int = 0,
+    length: Optional[int] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, bytes]:
     """Native scan: (field_starts, field_lens, rec_counts, scratch).
 
     field_starts < 0 index the scratch buffer at -(start+1); record
     ordinals for errors are 1-based like the reference's row numbers.
+    ``offset``/``length`` scan a sub-range of *data* with zero copies
+    (the parallel chunker's path); returned starts are range-relative.
     """
     lib = _load()
-    n = len(data)
+    n = len(data) - offset if length is None else length
+    base = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p).value + offset
     max_fields = ctypes.c_int64(0)
     max_records = ctypes.c_int64(0)
     lib.csv_count_bounds(
-        data,
+        base,
         n,
         delimiter.encode("utf-8"),
         ctypes.byref(max_fields),
@@ -125,12 +130,14 @@ def scan_bytes(
     starts = np.empty(mf, dtype=np.int64)
     lens = np.empty(mf, dtype=np.int32)
     counts = np.empty(mr, dtype=np.int32)
+    # NB: the `data` local keeps the bytes object alive (and its base
+    # address valid) for the duration of both native calls below
     scratch = ctypes.create_string_buffer(max(n, 1))
     scratch_used = ctypes.c_int64(0)
     err_record = ctypes.c_int64(0)
 
     rc = lib.csv_scan(
-        data,
+        base,
         n,
         delimiter.encode("utf-8"),
         (comment or "\x00").encode("utf-8")[0:1],
@@ -153,6 +160,62 @@ def scan_bytes(
     # nfields = rc; trim arrays
     total = int(rc)
     return starts[:total], lens[:total], counts[:nrec], scratch.raw[: scratch_used.value]
+
+
+_PARALLEL_MIN_BYTES = 8 << 20  # files below this parse fine in one pass
+
+
+def scan_bytes_parallel(
+    data: bytes,
+    delimiter: str = ",",
+    comment: Optional[str] = None,
+    lazy_quotes: bool = False,
+    n_threads: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, bytes]:
+    """Multi-threaded chunk scan for large QUOTE-FREE files.
+
+    The host-ingest-parallelism component from SURVEY.md §2: the byte
+    range is split at newline boundaries and each chunk runs through the
+    native scanner concurrently (ctypes releases the GIL).  Chunking at
+    newlines is only unambiguous when the file contains no quote
+    character — a quoted field could span lines — so quoted files take
+    the single-pass scan.  Quote-free chunks cannot raise parse errors
+    and never use the scratch buffer, which keeps the merge a pure
+    offset-shifted concatenation.
+    """
+    n = len(data)
+    k = min(n_threads or os.cpu_count() or 1, 16)
+    if n < _PARALLEL_MIN_BYTES or k < 2 or b'"' in data:
+        return scan_bytes(data, delimiter, comment, lazy_quotes)
+
+    # newline-aligned chunk bounds
+    bounds = [0]
+    for i in range(1, k):
+        target = i * n // k
+        pos = data.find(b"\n", target)
+        bounds.append(n if pos < 0 else pos + 1)
+    bounds.append(n)
+    bounds = sorted(set(bounds))
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    def scan_chunk(lo: int, hi: int):
+        # zero-copy: scan [lo, hi) of the shared buffer in place
+        return scan_bytes(
+            data, delimiter, comment, lazy_quotes, offset=lo, length=hi - lo
+        )
+
+    with ThreadPoolExecutor(max_workers=len(bounds) - 1) as pool:
+        parts = list(
+            pool.map(lambda b: scan_chunk(*b), zip(bounds[:-1], bounds[1:]))
+        )
+
+    starts = np.concatenate(
+        [p[0] + lo for p, lo in zip(parts, bounds[:-1])]
+    ) if parts else np.empty(0, np.int64)
+    lens = np.concatenate([p[1] for p in parts]) if parts else np.empty(0, np.int32)
+    counts = np.concatenate([p[2] for p in parts]) if parts else np.empty(0, np.int32)
+    return starts, lens, counts, b""
 
 
 def _field_str(data: bytes, scratch: bytes, start: int, length: int) -> str:
@@ -273,7 +336,7 @@ def _scan_for_reader(reader, path: str):
     with open(path, "rb") as f:
         data = f.read()
 
-    starts, lens, counts, scratch = scan_bytes(
+    starts, lens, counts, scratch = scan_bytes_parallel(
         data,
         delimiter=reader._delimiter,
         comment=reader._comment,

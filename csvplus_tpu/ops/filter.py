@@ -31,7 +31,7 @@ class UnsupportedPredicate(Exception):
 def build_mask(cols: Dict[str, StringColumn], nrows: int, pred) -> jnp.ndarray:
     """Lower *pred* to a device boolean mask over all *nrows* rows."""
     if isinstance(pred, Like):
-        mask = None
+        terms = []
         for col, val in pred.match.items():
             if col not in cols:
                 return jnp.zeros(nrows, dtype=bool)
@@ -39,9 +39,22 @@ def build_mask(cols: Dict[str, StringColumn], nrows: int, pred) -> jnp.ndarray:
             code = lookup_code(c.dictionary, val)
             if code < 0:
                 return jnp.zeros(nrows, dtype=bool)
-            m = c.codes == code
+            terms.append((c.codes, code))
+        assert terms  # Like() rejects empty match rows
+        if len(terms) >= 2:
+            # multi-column conjunction: one fused VMEM pass (Pallas),
+            # reading each row once instead of k intermediate masks
+            from .pallas_mask import fused_equality_mask
+
+            fused = fused_equality_mask(
+                [t[0] for t in terms], [t[1] for t in terms], nrows, mode="all"
+            )
+            if fused is not None:
+                return fused
+        mask = None
+        for codes, code in terms:
+            m = codes == code
             mask = m if mask is None else (mask & m)
-        assert mask is not None  # Like() rejects empty match rows
         return mask
     if isinstance(pred, All):
         mask = jnp.ones(nrows, dtype=bool)

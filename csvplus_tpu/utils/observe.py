@@ -60,7 +60,9 @@ class Telemetry:
 
     @contextlib.contextmanager
     def stage(self, name: str, rows_in: int) -> Iterator[dict]:
-        """Record one stage; the body may set ``out['rows_out']``."""
+        """Record one stage; the body may set ``out['rows_out']``, or set
+        ``out['discard'] = True`` to drop the record (e.g. a fast-path
+        tier that declined and handed off to another tier)."""
         if not self.enabled:
             yield {}
             return
@@ -68,6 +70,8 @@ class Telemetry:
         t0 = time.perf_counter()
         with _trace_annotation(f"csvplus:{name}"):
             yield out
+        if out.get("discard"):
+            return
         self.records.append(
             StageRecord(
                 stage=name,
@@ -87,12 +91,16 @@ telemetry = Telemetry()
 
 @contextlib.contextmanager
 def _trace_annotation(name: str):
+    # best-effort: only the annotation SETUP may be swallowed — exceptions
+    # from the body must propagate unchanged (a yield inside the except
+    # would turn them into "generator didn't stop after throw()")
     try:
         import jax.profiler
 
-        with jax.profiler.TraceAnnotation(name):
-            yield
-    except Exception:  # profiler unavailable: annotations are best-effort
+        cm = jax.profiler.TraceAnnotation(name)
+    except Exception:
+        cm = contextlib.nullcontext()
+    with cm:
         yield
 
 

@@ -399,3 +399,34 @@ def test_telemetry_collects_stages(dev_people):
     assert f.rows_in == 120 and f.rows_out == 12
     assert telemetry.report()
     assert not telemetry.enabled  # scope ended
+
+
+def test_telemetry_fallback_exception_transparent(dev_people):
+    """Exceptions inside telemetry-wrapped stages propagate unchanged
+    (review regression: the trace annotation wrapper must not double-
+    yield), so host fallback + pinned errors survive telemetry."""
+    from csvplus_tpu import telemetry
+
+    with telemetry.collect():
+        # opaque callback forces UnsupportedPlan -> host fallback path
+        rows = dev_people.filter(Like({"name": "Ava"})).filter(
+            lambda r: True
+        ).to_rows()
+        assert len(rows) == 12
+        # DataSourceError keeps its row number through telemetry
+        with pytest.raises(DataSourceError) as e:
+            dev_people.select_columns("zzz").to_rows()
+        assert str(e.value) == 'row 0: missing column "zzz"'
+
+
+def test_telemetry_native_tier_decline_not_recorded(tmp_path):
+    """A declined fast-path tier leaves no misleading stage record."""
+    from csvplus_tpu import from_file, telemetry
+
+    p = tmp_path / "long.csv"
+    p.write_text("a,b\n" + "x" * 500 + ",1\n")
+    with telemetry.collect() as recs:
+        from_file(str(p)).on_device("cpu")
+    stages = [r.stage for r in recs]
+    assert "ingest:native-encoded" not in stages
+    assert "ingest:python" in stages
