@@ -31,12 +31,17 @@ def source_from_table(table: DeviceTable) -> DataSource:
     return DataSource(plan_runner(plan), plan=plan)
 
 
-def reader_to_device(reader, device: str = "tpu", **opts) -> DataSource:
+def reader_to_device(
+    reader, device: str = "tpu", shards: "int | None" = None, mesh=None, **opts
+) -> DataSource:
     """Parse *reader*'s CSV into a DeviceTable and wrap it as a source.
 
     Fast path tiers: native scan + vectorized dictionary encode (no
     per-cell Python objects) > native scan + Python strings > pure-Python
     parse.  All three are differential-tested to identical results.
+
+    ``shards=N`` (or an explicit ``mesh``) lays the columns row-sharded
+    over a 1-D device mesh so the whole downstream pipeline runs SPMD.
     """
     from ..utils.observe import telemetry
 
@@ -57,14 +62,22 @@ def reader_to_device(reader, device: str = "tpu", **opts) -> DataSource:
                 else:
                     _t["discard"] = True  # tier declined; python tier records
             if enc is not None:
-                return source_from_table(table)
+                return source_from_table(_maybe_shard(table, shards, mesh))
         except ImportError:
             pass
     with telemetry.stage("ingest:python", 0) as _t:
         names, data = _read_columns_fast(reader, **opts)
         table = DeviceTable.from_pylists({n: data[n] for n in names}, device=device)
         _t["rows_out"] = table.nrows
-    return source_from_table(table)
+    return source_from_table(_maybe_shard(table, shards, mesh))
+
+
+def _maybe_shard(table: DeviceTable, shards, mesh) -> DeviceTable:
+    if mesh is None and shards:
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh(shards)
+    return table.with_sharding(mesh) if mesh is not None else table
 
 
 def _read_columns_fast(reader, **opts):

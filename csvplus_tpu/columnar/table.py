@@ -251,6 +251,31 @@ class DeviceTable:
     def column_names(self) -> List[str]:
         return list(self.columns)
 
+    def with_sharding(self, mesh) -> "DeviceTable":
+        """Re-lay every code array row-sharded over *mesh* (GSPMD).
+
+        All executor ops (masks, gathers, sorts, probes) are jnp ops, so
+        once the codes carry a ``NamedSharding`` XLA partitions the whole
+        pipeline data-parallel and inserts collectives where gathers or
+        sorts cross shards — the "pick a mesh, annotate shardings, let
+        XLA insert collectives" recipe.  The explicit ``shard_map``
+        partitioned join (csvplus_tpu/parallel/pjoin.py) remains the
+        hand-optimized path for very large build sides.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import AXIS
+
+        sharding = NamedSharding(mesh, P(AXIS))
+        cols = {}
+        for name, col in self.columns.items():
+            moved = StringColumn(
+                col.dictionary, jax.device_put(col.codes, sharding)
+            )
+            moved._str_dict = col._str_dict
+            moved._has_absent = col._has_absent
+            cols[name] = moved
+        return DeviceTable(cols, self.nrows, mesh.devices.flat[0])
+
     def short_desc(self) -> str:
         return f"{self.nrows}x{len(self.columns)}[{','.join(self.columns)}]"
 

@@ -141,6 +141,28 @@ class DeviceIndex:
         )
         return lower, upper
 
+    def _keys_for(self, qk: jax.Array) -> jax.Array:
+        """The packed key array, replicated onto the probe's mesh when the
+        probe side is row-sharded (broadcast-join layout: the small build
+        side goes everywhere, the probe stays put — no collectives in the
+        probe itself).  The replicated copy is cached per mesh."""
+        keys = self.packed_i32
+        qk_sh = getattr(qk, "sharding", None)
+        if qk_sh is None or len(qk_sh.device_set) <= 1:
+            return keys
+        if getattr(keys, "sharding", None) is not None and len(
+            keys.sharding.device_set
+        ) == len(qk_sh.device_set):
+            return keys
+        cached = getattr(self, "_repl_keys", None)
+        if cached is not None and cached[0] == qk_sh.device_set:
+            return cached[1]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = jax.device_put(keys, NamedSharding(qk_sh.mesh, P()))
+        self._repl_keys = (qk_sh.device_set, repl)
+        return repl
+
     def _translated(self, probe_cols: List[StringColumn], n_key_cols: int):
         """Per-column probe codes translated into the build dictionaries."""
         out = []
@@ -168,9 +190,8 @@ class DeviceIndex:
                 ok = ok & (c >= 0)
                 qk = qk | (jnp.where(c >= 0, c, 0).astype(jnp.int32) << s)
             qk = jnp.where(ok, qk, jnp.int32(-1))
-            lower, counts = _probe_kernel_i32(
-                self.packed_i32, qk, jnp.int32(1) << range_shift
-            )
+            keys = self._keys_for(qk)
+            lower, counts = _probe_kernel_i32(keys, qk, jnp.int32(1) << range_shift)
             return np.asarray(lower), np.asarray(counts)
 
         # wide keys: pack + search on host (numpy int64)

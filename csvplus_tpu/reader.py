@@ -290,16 +290,17 @@ class Reader:
 
     # -- device ingestion hook (M2) ----------------------------------------
 
-    def on_device(self, device: str = "tpu", **opts):
+    def on_device(self, device: str = "tpu", shards=None, mesh=None, **opts):
         """Parse this CSV into an HBM-resident columnar DeviceTable and
         return a plan-capable DataSource over it.
 
-        This is the rebuild's ``FromFile(...).OnDevice("tpu")`` entry point
-        from BASELINE.json's north star.
+        This is the rebuild's ``FromFile(...).OnDevice("tpu")`` entry
+        point from BASELINE.json's north star.  ``shards=N`` lays the
+        columns row-sharded over an N-device mesh (BASELINE config 5).
         """
         from .columnar.ingest import reader_to_device
 
-        return reader_to_device(self, device=device, **opts)
+        return reader_to_device(self, device=device, shards=shards, mesh=mesh, **opts)
 
     # Go-style aliases
     Delimiter = delimiter

@@ -346,7 +346,9 @@ class DataSource:
 
     # -- device migration --------------------------------------------------
 
-    def on_device(self, device: str = "tpu") -> "DataSource":
+    def on_device(
+        self, device: str = "tpu", shards: "int | None" = None, mesh=None
+    ) -> "DataSource":
         """Materialize this source into an HBM-resident columnar table and
         return a plan-capable DataSource over it.
 
@@ -356,10 +358,11 @@ class DataSource:
         (heterogeneous schemas allowed; missing cells stay absent), and
         subsequent symbolic stages run as device kernels.
         """
-        from .columnar.ingest import source_from_table
+        from .columnar.ingest import _maybe_shard, source_from_table
         from .columnar.table import DeviceTable
 
-        return source_from_table(DeviceTable.from_rows(self.to_rows(), device=device))
+        table = DeviceTable.from_rows(self.to_rows(), device=device)
+        return source_from_table(_maybe_shard(table, shards, mesh))
 
     OnDevice = on_device
 
