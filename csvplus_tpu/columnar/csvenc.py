@@ -1,0 +1,80 @@
+"""Vectorized CSV encoding of columnar results.
+
+The streaming sink (csvplus.go:379-406 analogue) calls a Python writer
+per row; for a device-resident result that is the last Python loop left
+in the pipeline.  This module assembles the whole CSV body with numpy
+string ops instead:
+
+* quoting/escaping runs once per **dictionary entry** (unique value),
+  not per cell — ``needs-quotes`` per Go csv.Writer's rules (delimiter,
+  quote, CR, LF, or a leading space/tab), ``""`` doubling via
+  ``np.char.replace``;
+* per-row lines are built by a vectorized ``np.char.add`` reduction over
+  the selected columns' decoded-and-escaped dictionaries taken by code.
+
+Output is byte-identical to the streaming writer
+(:func:`csvplus_tpu.csvio.write_record`); the sink falls back to
+streaming whenever exact per-row error semantics are in play (absent
+cells / missing columns), so behavior parity is preserved.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .table import DeviceTable
+
+
+def _escape_dictionary(d_str: np.ndarray, delimiter: str = ",") -> np.ndarray:
+    """Go csv.Writer fieldNeedsQuotes + escaping, applied per unique value."""
+    if d_str.size == 0:
+        return d_str
+    has_special = (
+        (np.char.find(d_str, delimiter) >= 0)
+        | (np.char.find(d_str, '"') >= 0)
+        | (np.char.find(d_str, "\r") >= 0)
+        | (np.char.find(d_str, "\n") >= 0)
+    )
+    first = d_str.astype("U1")
+    # Go: unicode.IsSpace on the first rune; np.char.isspace("") is False
+    leading_space = np.char.isspace(first)
+    backslash_dot = d_str == "\\."
+    needs = (has_special | leading_space | backslash_dot) & (d_str != "")
+    if not needs.any():
+        return d_str
+    escaped = np.char.add(
+        np.char.add('"', np.char.replace(d_str[needs], '"', '""')), '"'
+    )
+    out = d_str.astype(object)
+    out[needs] = escaped
+    return out.astype(np.str_)
+
+
+def encode_csv_body(table: DeviceTable, columns: Sequence[str]) -> Optional[str]:
+    """The CSV body (no header) for the selected columns, or None when
+    this fast path cannot guarantee streaming-sink parity (missing
+    columns or absent cells -> the caller streams instead, reproducing
+    exact per-row errors and partial output)."""
+    cols = []
+    for c in columns:
+        col = table.columns.get(c)
+        if col is None or col.has_absent:
+            return None
+        cols.append(col)
+    if table.nrows == 0:
+        return ""
+
+    pieces = []
+    for i, col in enumerate(cols):
+        d = _escape_dictionary(col.dictionary_str())
+        vals = d[np.asarray(col.codes)]
+        pieces.append(vals)
+        if i < len(cols) - 1:
+            pieces[-1] = np.char.add(vals, ",")
+    line = pieces[0]
+    for p in pieces[1:]:
+        line = np.char.add(line, p)
+    line = np.char.add(line, "\n")
+    return "".join(line.tolist())

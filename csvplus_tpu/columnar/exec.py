@@ -81,11 +81,17 @@ def execute_plan(root: P.PlanNode) -> DeviceTable:
     scan = stages[0]
     assert isinstance(scan, P.Scan)
     table: DeviceTable = scan.table
+    # full_len follows the stored column length, which may exceed nrows
+    # when codes are padded for mesh-sharding divisibility; the selection
+    # vector never reaches the padding rows
+    stored_len = (
+        len(next(iter(table.columns.values()))) if table.columns else table.nrows
+    )
     view = _View(
         dict(table.columns),
         np.arange(table.nrows, dtype=np.int64),
         table.device,
-        table.nrows,
+        stored_len,
     )
 
     from ..utils.observe import telemetry
@@ -208,7 +214,22 @@ def _apply_map(view: _View, expr) -> None:
         return
     if isinstance(expr, SetValue):
         n = _full_len(view)
-        view.cols[expr.column] = StringColumn.constant(expr.value, n, view.device)
+        ref = next(iter(view.cols.values()), None)
+        if ref is not None and getattr(ref.codes, "sharding", None) is not None:
+            # match the existing columns' (possibly mesh-sharded) layout,
+            # or mixing the constant into jitted ops crashes on devices
+            import jax as _jax
+
+            codes = _jax.device_put(
+                np.zeros(n, dtype=np.int32), ref.codes.sharding
+            )
+            view.cols[expr.column] = StringColumn(
+                np.asarray([expr.value.encode("utf-8")], dtype="S"), codes
+            )
+        else:
+            view.cols[expr.column] = StringColumn.constant(
+                expr.value, n, view.device
+            )
         return
     if isinstance(expr, Rename):
         # sequential pop/overwrite, matching the host expr exactly
@@ -239,14 +260,43 @@ def try_execute_plan(root: Optional[P.PlanNode]) -> Optional[List[Row]]:
         return None
 
 
-def plan_runner(root: P.PlanNode, fallback=None):
+def device_table_for(src) -> "DeviceTable | None":
+    """Execute *src*'s device plan to a table, or None when there is no
+    plan / it is unsupported.  An unsupported outcome is remembered on
+    the source so sinks and the runner never execute the same device
+    prefix twice.  (If an index gains a device copy AFTER the first
+    attempt, the source keeps using its host fallback — correct, merely
+    un-accelerated.)"""
+    plan = getattr(src, "plan", None)
+    if plan is None or getattr(src, "_plan_unsupported", False):
+        return None
+    try:
+        return execute_plan(plan)
+    except UnsupportedPlan:
+        try:
+            src._plan_unsupported = True
+        except AttributeError:
+            pass
+        return None
+
+
+def plan_runner(root: P.PlanNode, fallback=None, owner=None):
     """A DataSource driver that executes *root* on device and streams the
-    decoded rows; falls back to *fallback* when the plan is unsupported."""
+    decoded rows; falls back to *fallback* when the plan is unsupported
+    (memoized via *owner*, see :func:`device_table_for`)."""
 
     def run(fn) -> None:
+        if owner is not None and getattr(owner, "_plan_unsupported", False):
+            fallback(fn)
+            return
         try:
             table = execute_plan(root)
         except UnsupportedPlan:
+            if owner is not None:
+                try:
+                    owner._plan_unsupported = True
+                except AttributeError:
+                    pass
             if fallback is None:
                 raise
             fallback(fn)

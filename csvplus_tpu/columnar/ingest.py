@@ -28,7 +28,9 @@ def source_from_table(table: DeviceTable) -> DataSource:
     from ..plan import Scan
 
     plan = Scan(table)
-    return DataSource(plan_runner(plan), plan=plan)
+    ds = DataSource(None, plan=plan)
+    ds._run = plan_runner(plan, fallback=table.iterate, owner=ds)
+    return ds
 
 
 def reader_to_device(

@@ -106,7 +106,9 @@ class StringColumn:
         per-cell presence work entirely.
         """
         if self._has_absent is None:
-            self._has_absent = bool(jnp.any(self.codes < 0))
+            # absent is exactly -1; sharding pad rows use -2 and must not
+            # defeat this fast path
+            self._has_absent = bool(jnp.any(self.codes == ABSENT))
         return self._has_absent
 
     @classmethod
@@ -266,13 +268,20 @@ class DeviceTable:
         from ..parallel.mesh import AXIS
 
         sharding = NamedSharding(mesh, P(AXIS))
+        n_dev = mesh.devices.size
+        pad = (-self.nrows) % n_dev  # NamedSharding needs divisibility
         cols = {}
         for name, col in self.columns.items():
-            moved = StringColumn(
-                col.dictionary, jax.device_put(col.codes, sharding)
-            )
+            codes = np.asarray(col.codes)
+            if pad:
+                # -2 = padding (never matches; distinct from -1 = absent);
+                # padding rows live beyond nrows, outside every selection
+                codes = np.concatenate(
+                    [codes, np.full(pad, -2, dtype=np.int32)]
+                )
+            moved = StringColumn(col.dictionary, jax.device_put(codes, sharding))
             moved._str_dict = col._str_dict
-            moved._has_absent = col._has_absent
+            moved._has_absent = col._has_absent if not pad else None
             cols[name] = moved
         return DeviceTable(cols, self.nrows, mesh.devices.flat[0])
 

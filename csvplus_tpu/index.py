@@ -400,6 +400,10 @@ def _create_index_device(plan, columns: Tuple[str, ...]) -> Index:
     from .ops.sort import sort_table
 
     table = execute_plan(plan)
+    if table.nrows == 0:
+        # the host build validates per-row (csvplus.go:722-733), so an
+        # empty source yields an empty index without any column check
+        return Index(IndexImpl([], columns))
     for col in columns:
         if col not in table.columns:
             raise DataSourceError(

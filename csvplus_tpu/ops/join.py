@@ -150,9 +150,8 @@ class DeviceIndex:
         qk_sh = getattr(qk, "sharding", None)
         if qk_sh is None or len(qk_sh.device_set) <= 1:
             return keys
-        if getattr(keys, "sharding", None) is not None and len(
-            keys.sharding.device_set
-        ) == len(qk_sh.device_set):
+        keys_sh = getattr(keys, "sharding", None)
+        if keys_sh is not None and keys_sh.device_set == qk_sh.device_set:
             return keys
         cached = getattr(self, "_repl_keys", None)
         if cached is not None and cached[0] == qk_sh.device_set:
@@ -264,6 +263,16 @@ def join_tables(
     index-sorted order (csvplus.go:559)."""
     from ..columnar.table import merge_with_fallback
 
+    if stream.nrows == 0:
+        # per-row key validation never fires on an empty stream
+        # (csvplus.go:553-556): empty result, no error
+        empty = np.empty(0, dtype=np.int64)
+        out_cols = {
+            name: col.gather(empty)
+            for name, col in {**dev_index.table.columns, **stream.columns}.items()
+        }
+        return DeviceTable(out_cols, 0, stream.device)
+
     probe_cols = _checked_probe_cols(stream, columns)
     lower, counts = dev_index.probe(probe_cols, stream.nrows)
     probe_ids, build_ids = expand_matches(lower, counts)
@@ -284,6 +293,8 @@ def except_mask(
     stream: DeviceTable, dev_index: "DeviceIndex", columns: Sequence[str]
 ) -> np.ndarray:
     """Boolean keep-mask for the anti-join (csvplus.go:585-608)."""
+    if stream.nrows == 0:
+        return np.zeros(0, dtype=bool)
     probe_cols = _checked_probe_cols(stream, columns)
     _, counts = dev_index.probe(probe_cols, stream.nrows)
     return counts == 0

@@ -25,11 +25,37 @@ from .row import Row
 
 def to_csv(src, out: IO[str], *columns: str) -> None:
     """Write selected columns in canonical CSV form: header line first,
-    fixed arity (csvplus.go:379-406)."""
+    fixed arity (csvplus.go:379-406).
+
+    Device-planned sources encode the whole body with vectorized numpy
+    string ops (byte-identical to the streaming writer); anything that
+    needs per-row error semantics streams row by row.
+    """
     if not columns:
         raise ValueError("empty column list in ToCsv() function")
 
     write_record(out, list(columns))
+
+    if getattr(src, "plan", None) is not None:
+        from .columnar.csvenc import encode_csv_body
+        from .columnar.exec import device_table_for
+
+        table = device_table_for(src)  # memoized: never runs a prefix twice
+        if table is not None:
+            body = encode_csv_body(table, columns)
+            if body is not None:
+                out.write(body)
+                return
+            # stream the already-computed table for exact per-row
+            # missing-column errors / partial output
+            from .source import iterate
+
+            iterate(
+                table.to_rows(),
+                lambda row: write_record(out, row.select_values(*columns)),
+                clone=False,
+            )
+            return
 
     def fn(row: Row) -> None:
         write_record(out, row.select_values(*columns))

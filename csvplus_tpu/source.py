@@ -67,11 +67,12 @@ class DataSource:
     :func:`csvplus_tpu.reader.from_file`.
     """
 
-    __slots__ = ("_run", "plan")
+    __slots__ = ("_run", "plan", "_plan_unsupported")
 
     def __init__(self, run: Callable[[RowFunc], None], plan: Any = None):
         self._run = run
         self.plan = plan  # symbolic plan IR node, or None (host-only chain)
+        self._plan_unsupported = False  # memo: device plan known-unsupported
 
     # -- execution ---------------------------------------------------------
 
@@ -422,7 +423,9 @@ def _make(run, plan) -> "DataSource":
         return DataSource(run)
     from .columnar.exec import plan_runner
 
-    return DataSource(plan_runner(plan, fallback=run), plan=plan)
+    ds = DataSource(run, plan=plan)
+    ds._run = plan_runner(plan, fallback=run, owner=ds)
+    return ds
 
 
 def _resolve_join_columns(index, columns: Sequence[str], what: str) -> List[str]:

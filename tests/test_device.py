@@ -430,3 +430,46 @@ def test_telemetry_native_tier_decline_not_recorded(tmp_path):
     stages = [r.stage for r in recs]
     assert "ingest:native-encoded" not in stages
     assert "ingest:python" in stages
+
+
+def test_vectorized_csv_sink_byte_identical(people_csv, tmp_path):
+    """The vectorized CSV body encoder is byte-identical to streaming,
+    including quoting edge cases."""
+    from csvplus_tpu import TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    rows = [
+        Row({"a": 'say "hi"', "b": "x,y"}),
+        Row({"a": " lead", "b": "plain"}),
+        Row({"a": "", "b": "\\."}),
+        Row({"a": "nl\nin", "b": "cr\rin"}),
+        Row({"a": "Zoë", "b": "tab\tstart"}),
+    ]
+    import io as _io
+
+    host_buf, dev_buf = _io.StringIO(), _io.StringIO()
+    TakeRows(rows).to_csv(host_buf, "a", "b")
+    source_from_table(DeviceTable.from_rows(rows, device="cpu")).to_csv(
+        dev_buf, "a", "b"
+    )
+    assert dev_buf.getvalue() == host_buf.getvalue()
+    # whole-file parity on the corpus too
+    h, d = str(tmp_path / "h.csv"), str(tmp_path / "d.csv")
+    Take(from_file(people_csv)).to_csv_file(h, "id", "name", "surname", "born")
+    from csvplus_tpu import from_file as ff
+
+    ff(people_csv).on_device("cpu").to_csv_file(d, "id", "name", "surname", "born")
+    assert open(d, "rb").read() == open(h, "rb").read()
+
+
+def test_vectorized_csv_sink_missing_column_streams(people_csv, tmp_path):
+    """Missing column still yields the streaming path's row-numbered
+    error and no partial file."""
+    import os as _os
+
+    dev = from_file(people_csv).on_device("cpu")
+    path = str(tmp_path / "x.csv")
+    with pytest.raises(DataSourceError):
+        dev.to_csv_file(path, "id", "zzz")
+    assert not _os.path.exists(path)
