@@ -52,6 +52,38 @@ def _escape_dictionary(d_str: np.ndarray, delimiter: str = ",") -> np.ndarray:
     return out.astype(np.str_)
 
 
+def encode_json_body(table: DeviceTable) -> Optional[str]:
+    """The JSON array body (between the brackets), byte-identical to the
+    streaming sink (sorted keys, compact separators, newline per object,
+    comma-separated); None when any column has absent cells (rows then
+    differ in schema, so the streaming path handles them)."""
+    import json
+
+    names = sorted(table.columns)
+    cols = []
+    for c in names:
+        col = table.columns[c]
+        if col.has_absent:
+            return None
+        cols.append(col)
+    if table.nrows == 0:
+        return ""
+
+    line = None
+    for i, (name, col) in enumerate(zip(names, cols)):
+        d = col.dictionary_str()
+        enc = np.asarray(
+            [json.dumps(v, ensure_ascii=False) for v in d.tolist()],
+            dtype=np.str_,
+        )
+        vals = enc[np.asarray(col.codes)]
+        prefix = ("{" if i == 0 else ",") + json.dumps(name) + ":"
+        piece = np.char.add(prefix, vals)
+        line = piece if line is None else np.char.add(line, piece)
+    line = np.char.add(line, "}")
+    return "\n,".join(line.tolist()) + "\n"
+
+
 def encode_csv_body(table: DeviceTable, columns: Sequence[str]) -> Optional[str]:
     """The CSV body (no header) for the selected columns, or None when
     this fast path cannot guarantee streaming-sink parity (missing

@@ -76,6 +76,23 @@ def to_json(src, out: IO[str]) -> None:
     object compactly with **sorted keys**, followed by a newline; objects
     are comma-separated inside ``[...]`` and flushed in ~10KB batches.
     """
+    if getattr(src, "plan", None) is not None:
+        from .columnar.csvenc import encode_json_body
+        from .columnar.exec import device_table_for
+
+        table = device_table_for(src)
+        if table is not None:
+            body = encode_json_body(table)
+            if body is not None:
+                out.write("[" + body + "]")
+                return
+            # heterogeneous rows: stream the computed table instead
+            from .source import iterate
+
+            rows_out: List[Row] = []
+            iterate(table.to_rows(), rows_out.append, clone=False)
+            src = lambda fn: [fn(r) for r in rows_out]  # noqa: E731
+
     buf: List[str] = ["["]
     buf_len = 1
     count = 0

@@ -473,3 +473,33 @@ def test_vectorized_csv_sink_missing_column_streams(people_csv, tmp_path):
     with pytest.raises(DataSourceError):
         dev.to_csv_file(path, "id", "zzz")
     assert not _os.path.exists(path)
+
+
+def test_vectorized_json_sink_byte_identical(people_csv, dev_people, host_people):
+    import io as _io
+
+    a, b = _io.StringIO(), _io.StringIO()
+    host_people.to_json(a)
+    dev_people.to_json(b)
+    assert b.getvalue() == a.getvalue()
+    # unicode + special chars through the json fast path
+    from csvplus_tpu import TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    rows = [Row({"a": 'q"\\', "b": "Zoë\nnl"}), Row({"a": "", "b": "\t"})]
+    c, d = _io.StringIO(), _io.StringIO()
+    TakeRows(rows).to_json(c)
+    source_from_table(DeviceTable.from_rows(rows, device="cpu")).to_json(d)
+    assert d.getvalue() == c.getvalue()
+    # heterogeneous rows stream but stay identical
+    het = [Row({"a": "1"}), Row({"b": "2"})]
+    e, f = _io.StringIO(), _io.StringIO()
+    TakeRows(het).to_json(e)
+    source_from_table(DeviceTable.from_rows(het, device="cpu")).to_json(f)
+    assert f.getvalue() == e.getvalue()
+    # empty
+    g, h = _io.StringIO(), _io.StringIO()
+    TakeRows([]).to_json(g)
+    source_from_table(DeviceTable.from_rows([], device="cpu")).to_json(h)
+    assert h.getvalue() == g.getvalue() == "[]"
