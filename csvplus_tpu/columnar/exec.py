@@ -117,6 +117,19 @@ def _exec_stage(view: "_View", node: P.PlanNode) -> "_View":
             raise UnsupportedPlan(str(e)) from e
         mask_np = np.asarray(mask)
         view.sel = view.sel[mask_np[view.sel]]
+    elif isinstance(node, P.TakeWhile) or isinstance(node, P.DropWhile):
+        nrows = _full_len(view)
+        try:
+            mask = build_mask(view.cols, nrows, node.pred)
+        except UnsupportedPredicate as e:
+            raise UnsupportedPlan(str(e)) from e
+        mask_sel = np.asarray(mask)[view.sel]
+        false_pos = np.flatnonzero(~mask_sel)
+        cut = int(false_pos[0]) if false_pos.size else view.sel.shape[0]
+        if isinstance(node, P.TakeWhile):
+            view.sel = view.sel[:cut]  # stop permanently at first false
+        else:
+            view.sel = view.sel[cut:]  # yield from first false onward
     elif isinstance(node, P.Top):
         view.sel = view.sel[: node.n]
     elif isinstance(node, P.DropRows):

@@ -32,8 +32,9 @@ Key-width tiers (TPUs are 32-bit-native; JAX int64 needs global x64):
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import ClassVar, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -74,6 +75,14 @@ class DeviceIndex:
     packed_i32: Optional[jax.Array]  # int32[n] sorted, device (narrow keys)
     packed_i64: Optional[np.ndarray]  # int64[n] sorted, host (wide keys)
     shifts: Optional[List[int]]  # bit offset per key column
+
+    # Build sides with at least this many keys probe via the range-
+    # partitioned lax.all_to_all path (parallel/pjoin.py) instead of
+    # replicating onto every shard; below it, broadcast wins.  ClassVar:
+    # NOT a dataclass field, so tests/operators can override on the class.
+    PARTITION_MIN_KEYS: ClassVar[int] = int(
+        os.environ.get("CSVPLUS_PARTITION_MIN_KEYS", 4_000_000)
+    )
 
     @classmethod
     def build(cls, table: DeviceTable, key_columns: Sequence[str]) -> "DeviceIndex":
@@ -141,6 +150,21 @@ class DeviceIndex:
         )
         return lower, upper
 
+    def _partitioned_for(self, qk_sh):
+        """Range-partitioned build keys for *qk_sh*'s mesh, cached per
+        device set (mirrors _keys_for's replication cache — the O(n)
+        host partitioning and device upload happen once, not per probe)."""
+        cached = getattr(self, "_part_cache", None)
+        if cached is not None and cached[0] == qk_sh.device_set:
+            return cached[1]
+        from ..parallel.pjoin import prepare_partitioned
+
+        prepared = prepare_partitioned(
+            qk_sh.mesh, np.asarray(self.packed_i32)
+        )
+        self._part_cache = (qk_sh.device_set, prepared)
+        return prepared
+
     def _keys_for(self, qk: jax.Array) -> jax.Array:
         """The packed key array, replicated onto the probe's mesh when the
         probe side is row-sharded (broadcast-join layout: the small build
@@ -189,6 +213,30 @@ class DeviceIndex:
                 ok = ok & (c >= 0)
                 qk = qk | (jnp.where(c >= 0, c, 0).astype(jnp.int32) << s)
             qk = jnp.where(ok, qk, jnp.int32(-1))
+
+            # large build sides probed by a MESH-SHARDED stream: don't
+            # replicate — range-partition the key array across the
+            # stream's own mesh (respecting device pinning) and shuffle
+            # probes over ICI all_to_all.  Full-width probes only; prefix
+            # probes and unsharded streams broadcast.
+            qk_sh = getattr(qk, "sharding", None)
+            if (
+                k == len(self.key_columns)
+                and int(self.packed_i32.shape[0]) >= self.PARTITION_MIN_KEYS
+                and qk_sh is not None
+                and len(qk_sh.device_set) > 1
+                and hasattr(qk_sh, "mesh")
+            ):
+                from ..parallel.pjoin import partitioned_probe
+
+                lower, counts = partitioned_probe(
+                    qk_sh.mesh,
+                    np.asarray(qk),
+                    np.asarray(self.packed_i32),
+                    prepared=self._partitioned_for(qk_sh),
+                )
+                return lower, counts
+
             keys = self._keys_for(qk)
             lower, counts = _probe_kernel_i32(keys, qk, jnp.int32(1) << range_shift)
             return np.asarray(lower), np.asarray(counts)

@@ -159,11 +159,26 @@ def _probe_spmd(mesh, n_shards, capacity, qk_sharded, keys_local, splits, base):
     return f(qk_sharded, keys_local, splits, base)
 
 
+def prepare_partitioned(mesh: Mesh, index_keys_sorted: np.ndarray):
+    """Range-partition + upload the build keys once; reusable across
+    probes (see DeviceIndex._partitioned_for's cache)."""
+    n_shards = mesh.devices.size
+    local, splits, base = partition_sorted_keys(
+        index_keys_sorted.astype(np.int32), n_shards
+    )
+    return (
+        jax.device_put(local.reshape(-1), NamedSharding(mesh, P(AXIS))),
+        jax.device_put(splits, NamedSharding(mesh, P())),
+        jax.device_put(base, NamedSharding(mesh, P())),
+    )
+
+
 def partitioned_probe(
     mesh: Mesh,
     stream_keys: np.ndarray,
     index_keys_sorted: np.ndarray,
     capacity: "int | None" = None,
+    prepared=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """All-to-all partitioned probe: for every stream key, the global
     ``[lower, lower+count)`` match range in the sorted index key array.
@@ -171,11 +186,13 @@ def partitioned_probe(
     Host-facing wrapper: pads, shards, runs the SPMD kernel, retries on
     capacity overflow, unpads.  Keys must be int32 packed keys with -1
     for invalid probes (absent/unmatched dictionary translation).
+    *prepared* short-circuits the partition+upload with the result of
+    :func:`prepare_partitioned`.
     """
     n_shards = mesh.devices.size
-    local, splits, base = partition_sorted_keys(
-        index_keys_sorted.astype(np.int32), n_shards
-    )
+    if prepared is None:
+        prepared = prepare_partitioned(mesh, index_keys_sorted)
+    keys_dev, splits_dev, base_dev = prepared
 
     qk, true_len = pad_to_multiple(stream_keys.astype(np.int32), n_shards, np.int32(-1))
     m_per_shard = qk.shape[0] // n_shards
@@ -185,9 +202,6 @@ def partitioned_probe(
     capacity = 1 << (int(capacity) - 1).bit_length()  # pow2 buckets limit recompiles
 
     qk_dev = jax.device_put(qk, NamedSharding(mesh, P(AXIS)))
-    keys_dev = jax.device_put(local.reshape(-1), NamedSharding(mesh, P(AXIS)))
-    splits_dev = jax.device_put(splits, NamedSharding(mesh, P()))
-    base_dev = jax.device_put(base, NamedSharding(mesh, P()))
 
     while True:
         lo, ct = _probe_spmd(

@@ -89,6 +89,18 @@ class DropRows(PlanNode):
 
 
 @dataclass(frozen=True)
+class TakeWhile(PlanNode):
+    child: PlanNode
+    pred: Any
+
+
+@dataclass(frozen=True)
+class DropWhile(PlanNode):
+    child: PlanNode
+    pred: Any
+
+
+@dataclass(frozen=True)
 class Join(PlanNode):
     child: PlanNode
     index: Any  # index.Index backed by a device table
@@ -150,6 +162,18 @@ def top_plan(child: Optional[PlanNode], n: int) -> Optional[PlanNode]:
 
 def drop_plan(child: Optional[PlanNode], n: int) -> Optional[PlanNode]:
     return DropRows(child, n) if child is not None else None
+
+
+def take_while_plan(child: Optional[PlanNode], pred: Any) -> Optional[PlanNode]:
+    if child is not None and _is_symbolic(pred):
+        return TakeWhile(child, pred)
+    return None
+
+
+def drop_while_plan(child: Optional[PlanNode], pred: Any) -> Optional[PlanNode]:
+    if child is not None and _is_symbolic(pred):
+        return DropWhile(child, pred)
+    return None
 
 
 def join_plan(

@@ -235,7 +235,8 @@ class DataSource:
 
             self._run(step)
 
-        return DataSource(run)
+        from .plan import take_while_plan
+        return _make(run, take_while_plan(self.plan, pred))
 
     def drop_while(self, pred: Callable[[Row], bool]) -> "DataSource":
         """Skip rows while *pred* holds, then pass everything (csvplus.go:362-374)."""
@@ -252,7 +253,8 @@ class DataSource:
 
             self._run(step)
 
-        return DataSource(run)
+        from .plan import drop_while_plan
+        return _make(run, drop_while_plan(self.plan, pred))
 
     # -- column projection (csvplus.go:492-525) ----------------------------
 

@@ -503,3 +503,26 @@ def test_vectorized_json_sink_byte_identical(people_csv, dev_people, host_people
     TakeRows([]).to_json(g)
     source_from_table(DeviceTable.from_rows([], device="cpu")).to_json(h)
     assert h.getvalue() == g.getvalue() == "[]"
+
+
+def test_take_drop_while_symbolic_parity(host_people, dev_people):
+    """Symbolic TakeWhile/DropWhile lower to a prefix cut on device."""
+    assert dev_people.take_while(Like({"name": "Amelia"})).plan is not None
+    for stage in [
+        lambda s: s.take_while(Like({"name": "Amelia"})),
+        lambda s: s.drop_while(Like({"name": "Amelia"})),
+        lambda s: s.take_while(Not(Like({"name": "NoSuch"}))),  # never stops
+        lambda s: s.drop_while(Not(Like({"name": "NoSuch"}))),  # drops all
+        lambda s: s.filter(Like({"surname": "Smith"})).take_while(
+            Not(Like({"name": "Oliver"}))
+        ),
+        lambda s: s.drop_while(Like({"name": "Amelia"})).take_while(
+            Not(Like({"name": "Jack"}))
+        ).top(7),
+    ]:
+        same(stage(dev_people).to_rows(), stage(host_people).to_rows())
+    # opaque predicates still fall back
+    f = lambda r: r["name"] == "Amelia"
+    same(
+        dev_people.take_while(f).to_rows(), host_people.take_while(f).to_rows()
+    )

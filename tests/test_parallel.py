@@ -279,3 +279,55 @@ def test_unsupported_plan_memoized(people_csv):
         assert src._plan_unsupported
     finally:
         ex.execute_plan = orig
+
+
+def test_executor_join_partitioned_path(people_csv, orders_csv, monkeypatch):
+    """With a low partition threshold and a SHARDED stream, the generic
+    executor's join probes via the all_to_all partitioned path — proven
+    by counting partitioned_probe calls — and stays identical."""
+    import csvplus_tpu.ops.join as J
+    import csvplus_tpu.parallel.pjoin as PJ
+    from csvplus_tpu import Take, from_file
+
+    monkeypatch.setattr(J.DeviceIndex, "PARTITION_MIN_KEYS", 1)
+    calls = {"n": 0}
+    orig = PJ.partitioned_probe
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    # ops.join imports partitioned_probe from the module at call time,
+    # so patching the module attribute intercepts the executor's calls
+    monkeypatch.setattr(PJ, "partitioned_probe", counting)
+
+    cust = Take(
+        from_file(people_csv).select_columns("id", "name", "surname")
+    ).unique_index_on("id")
+    host_rows = (
+        Take(from_file(orders_csv).select_columns("cust_id", "qty"))
+        .join(cust, "cust_id")
+        .to_rows()
+    )
+    cust.on_device("cpu")
+    dev_rows = (
+        from_file(orders_csv)
+        .on_device("cpu", shards=8)  # sharded stream engages partitioning
+        .select_columns("cust_id", "qty")
+        .join(cust, "cust_id")
+        .to_rows()
+    )
+    assert dev_rows == host_rows
+    assert calls["n"] >= 1  # the partitioned path actually ran
+    # an UNSHARDED stream keeps broadcasting (placement-respecting gate)
+    n0 = calls["n"]
+    dev2 = (
+        from_file(orders_csv)
+        .on_device("cpu")
+        .select_columns("cust_id", "qty")
+        .join(cust, "cust_id")
+        .to_rows()
+    )
+    assert dev2 == host_rows and calls["n"] == n0
+    # prefix probes (Find) keep using broadcast and stay correct
+    assert cust.find("55").to_rows() == [r for r in Take(cust) if r["id"] == "55"]
