@@ -122,13 +122,28 @@ class ThreewayJoin:
         ids_c = jnp.take(lo_c, sel_dev, axis=0)
         ids_p = jnp.take(lo_p, sel_dev, axis=0)
 
+        # one fused gather call per side (compiled once per shape)
+        names_c = list(self.cust.table.columns)
+        names_p = list(self.prod.table.columns)
+        names_o = list(self.orders_cols)
+        ones = jnp.ones(sel.shape[0], dtype=bool)
+        g_c = gather_columns(
+            ids_c, ones, *(self.cust.table.columns[n].codes for n in names_c)
+        )
+        g_p = gather_columns(
+            ids_p, ones, *(self.prod.table.columns[n].codes for n in names_p)
+        )
+        g_o = gather_columns(
+            sel_dev, ones, *(self.orders_cols[n].codes for n in names_o)
+        )
+
         out: Dict[str, StringColumn] = {}
-        for name, col in self.cust.table.columns.items():
-            out[name] = StringColumn(col.dictionary, jnp.take(col.codes, ids_c, axis=0))
-        for name, col in self.prod.table.columns.items():
-            out[name] = StringColumn(col.dictionary, jnp.take(col.codes, ids_p, axis=0))
-        for name, col in self.orders_cols.items():  # stream wins
-            out[name] = StringColumn(col.dictionary, jnp.take(col.codes, sel_dev, axis=0))
+        for name, codes in zip(names_c, g_c):
+            out[name] = StringColumn(self.cust.table.columns[name].dictionary, codes)
+        for name, codes in zip(names_p, g_p):
+            out[name] = StringColumn(self.prod.table.columns[name].dictionary, codes)
+        for name, codes in zip(names_o, g_o):  # stream wins
+            out[name] = StringColumn(self.orders_cols[name].dictionary, codes)
         device = next(iter(out.values())).codes.device if out else None
         return DeviceTable(out, int(sel.shape[0]), device)
 

@@ -1,0 +1,68 @@
+"""Quickstart: the reference README's two examples on this framework.
+
+Run:  python examples/quickstart.py [--device]
+(--device runs the pipelines through the columnar device executor.)
+"""
+
+import csv
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import csvplus_tpu as csvplus
+
+
+def make_corpus(root):
+    with open(f"{root}/people.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["id", "name", "surname"])
+        for i, (n, s) in enumerate(
+            [("Amelia", "Smith"), ("Amelia", "Jones"), ("Jack", "Taylor")]
+        ):
+            w.writerow([str(i), n, s])
+    with open(f"{root}/stock.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["prod_id", "product", "price"])
+        w.writerow(["0", "orange", "0.03"])
+        w.writerow(["1", "apple", "0.02"])
+    with open(f"{root}/orders.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["cust_id", "prod_id", "qty", "ts"])
+        w.writerow(["1", "0", "38", "2016-09-14T08:48:22+01:00"])
+        w.writerow(["2", "1", "5", "2016-09-14T09:00:00+01:00"])
+
+
+def main():
+    on_device = "--device" in sys.argv
+    with tempfile.TemporaryDirectory() as root:
+        make_corpus(root)
+
+        def src(path, *cols):
+            r = csvplus.FromFile(path).SelectColumns(*cols)
+            return r.OnDevice() if on_device else csvplus.Take(r)
+
+        # example 1: filter + map + csv out (README.md:20-26 analogue)
+        out = f"{root}/out.csv"
+        src(f"{root}/people.csv", "name", "surname", "id") \
+            .Filter(csvplus.Like({"name": "Amelia"})) \
+            .Map(csvplus.SetValue("name", "Julia")) \
+            .ToCsvFile(out, "name", "surname")
+        print(open(out).read())
+
+        # example 2: 3-table join (README.md:34-65 analogue)
+        cust = src(f"{root}/people.csv", "id", "name", "surname").UniqueIndexOn("id")
+        prod = src(f"{root}/stock.csv", "prod_id", "product", "price").UniqueIndexOn("prod_id")
+        if on_device:
+            cust.OnDevice()
+            prod.OnDevice()
+        orders = src(f"{root}/orders.csv", "cust_id", "prod_id", "qty", "ts")
+        for row in orders.Join(cust, "cust_id").Join(prod):
+            print(
+                f'{row["name"]} {row["surname"]} bought {row["qty"]} '
+                f'{row["product"]}s for £{row["price"]} each on {row["ts"]}'
+            )
+
+
+if __name__ == "__main__":
+    main()
