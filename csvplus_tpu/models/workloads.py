@@ -14,7 +14,7 @@ are here so every benchmark config has a first-class, importable form:
 
 from __future__ import annotations
 
-from typing import Iterable, Optional, Sequence
+from typing import Iterable, Sequence
 
 from ..predicates import Like
 from ..exprs import SetValue
@@ -36,7 +36,7 @@ def index_build(source, key: str, probes: Iterable[Sequence[str]] = ()):
 
 def threeway(orders, cust_index, prod_index, cust_col="cust_id", prod_col="prod_id"):
     """Config 3: the README 3-table join as a lazy pipeline."""
-    return orders.join(cust_index, cust_col).join(prod_index)
+    return orders.join(cust_index, cust_col).join(prod_index, prod_col)
 
 
 def dedup(source, key: str, policy="first"):

@@ -67,12 +67,25 @@ class DataSource:
     :func:`csvplus_tpu.reader.from_file`.
     """
 
-    __slots__ = ("_run", "plan", "_plan_unsupported")
+    __slots__ = ("_run", "plan", "_plan_unsupported", "plan_note")
 
     def __init__(self, run: Callable[[RowFunc], None], plan: Any = None):
         self._run = run
         self.plan = plan  # symbolic plan IR node, or None (host-only chain)
         self._plan_unsupported = False  # memo: device plan known-unsupported
+        self.plan_note = None  # why device execution stopped, if it did
+
+    def explain(self) -> str:
+        """Human-readable execution plan: the device plan when the chain
+        is symbolic, or where (and why) it falls to the host path —
+        the 'plan printer' from SURVEY.md §7's callback-escape-hatch
+        requirement."""
+        from .plan import explain as _explain
+
+        base = _explain(self.plan)
+        if self.plan is None and self.plan_note:
+            return f"{base}\n  device execution stopped at: {self.plan_note}"
+        return base
 
     # -- execution ---------------------------------------------------------
 
@@ -145,7 +158,7 @@ class DataSource:
             self._run(step)
 
         from .plan import transform_plan
-        return _make(run, transform_plan(self.plan, trans))
+        return _make(run, transform_plan(self.plan, trans), self, "transform", trans)
 
     def filter(self, pred: Callable[[Row], bool]) -> "DataSource":
         """Keep rows for which *pred* is true (csvplus.go:276-286)."""
@@ -158,7 +171,7 @@ class DataSource:
             self._run(step)
 
         from .plan import filter_plan
-        return _make(run, filter_plan(self.plan, pred))
+        return _make(run, filter_plan(self.plan, pred), self, "filter", pred)
 
     def map(self, mf: Callable[[Row], Row]) -> "DataSource":
         """Apply *mf* to every row (csvplus.go:290-296)."""
@@ -171,7 +184,7 @@ class DataSource:
             self._run(step)
 
         from .plan import map_plan
-        return _make(run, map_plan(self.plan, mf))
+        return _make(run, map_plan(self.plan, mf), self, "map", mf)
 
     def validate(self, vf: Callable[[Row], None]) -> "DataSource":
         """Check every row; *vf* raises to fail the pipeline at that row
@@ -184,7 +197,7 @@ class DataSource:
 
             self._run(step)
 
-        return DataSource(run)
+        return _make(run, None, self, "validate", vf)
 
     # -- windowing combinators (csvplus.go:312-374) ------------------------
 
@@ -204,7 +217,7 @@ class DataSource:
             self._run(step)
 
         from .plan import top_plan
-        return _make(run, top_plan(self.plan, n))
+        return _make(run, top_plan(self.plan, n), self)
 
     def drop(self, n: int) -> "DataSource":
         """Skip the first *n* rows (csvplus.go:329-342)."""
@@ -222,7 +235,7 @@ class DataSource:
             self._run(step)
 
         from .plan import drop_plan
-        return _make(run, drop_plan(self.plan, n))
+        return _make(run, drop_plan(self.plan, n), self)
 
     def take_while(self, pred: Callable[[Row], bool]) -> "DataSource":
         """Pass rows until *pred* is first false, then stop (csvplus.go:346-358)."""
@@ -236,7 +249,7 @@ class DataSource:
             self._run(step)
 
         from .plan import take_while_plan
-        return _make(run, take_while_plan(self.plan, pred))
+        return _make(run, take_while_plan(self.plan, pred), self, "take_while", pred)
 
     def drop_while(self, pred: Callable[[Row], bool]) -> "DataSource":
         """Skip rows while *pred* holds, then pass everything (csvplus.go:362-374)."""
@@ -254,7 +267,7 @@ class DataSource:
             self._run(step)
 
         from .plan import drop_while_plan
-        return _make(run, drop_while_plan(self.plan, pred))
+        return _make(run, drop_while_plan(self.plan, pred), self, "drop_while", pred)
 
     # -- column projection (csvplus.go:492-525) ----------------------------
 
@@ -272,7 +285,7 @@ class DataSource:
             self._run(step)
 
         from .plan import drop_columns_plan
-        return _make(run, drop_columns_plan(self.plan, columns))
+        return _make(run, drop_columns_plan(self.plan, columns), self)
 
     def select_columns(self, *columns: str) -> "DataSource":
         """Keep exactly the listed columns; error if any is missing
@@ -287,7 +300,7 @@ class DataSource:
             self._run(step)
 
         from .plan import select_columns_plan
-        return _make(run, select_columns_plan(self.plan, columns))
+        return _make(run, select_columns_plan(self.plan, columns), self)
 
     # -- index / join entry points (implemented in index.py) ---------------
 
@@ -327,7 +340,7 @@ class DataSource:
             self._run(step)
 
         from .plan import join_plan
-        return _make(run, join_plan(self.plan, index, cols))
+        return _make(run, join_plan(self.plan, index, cols), self, "join")
 
     def except_(self, index, *columns: str) -> "DataSource":
         """Anti-join: pass through rows whose key is NOT in *index*
@@ -345,7 +358,7 @@ class DataSource:
             self._run(step)
 
         from .plan import except_plan
-        return _make(run, except_plan(self.plan, index, cols))
+        return _make(run, except_plan(self.plan, index, cols), self, "except")
 
     # -- device migration --------------------------------------------------
 
@@ -418,16 +431,42 @@ class DataSource:
     ToRows = to_rows
 
 
-def _make(run, plan) -> "DataSource":
+_STAGE_BREAK_NOTES = {
+    "join": "join() against an index with no device copy "
+    "(call index.on_device() to keep the chain on device)",
+    "except": "except_() against an index with no device copy "
+    "(call index.on_device() to keep the chain on device)",
+    "validate": "validate() callbacks have no symbolic form",
+}
+
+
+def _make(run, plan, parent=None, stage: str = "", arg: Any = None) -> "DataSource":
     """Build a combinator result: device plan execution when the chain is
-    symbolic, with *run* (the host streaming closure) as fallback."""
+    symbolic, with *run* (the host streaming closure) as fallback.  When
+    the stage BREAKS an existing device plan (opaque argument / host-only
+    index), the reason is recorded — and carried through later stages —
+    for :meth:`DataSource.explain`."""
     if plan is None:
-        return DataSource(run)
+        ds = DataSource(run)
+        if parent is not None:
+            if parent.plan is not None and stage:
+                ds.plan_note = _STAGE_BREAK_NOTES.get(
+                    stage, f"{stage}({_describe_arg(arg)}) is not symbolic"
+                )
+            else:
+                ds.plan_note = parent.plan_note  # keep the original reason
+        return ds
     from .columnar.exec import plan_runner
 
     ds = DataSource(run, plan=plan)
     ds._run = plan_runner(plan, fallback=run, owner=ds)
     return ds
+
+
+def _describe_arg(arg: Any) -> str:
+    if arg is None:
+        return ""
+    return getattr(arg, "__name__", None) or type(arg).__name__
 
 
 def _resolve_join_columns(index, columns: Sequence[str], what: str) -> List[str]:

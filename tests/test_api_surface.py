@@ -1,0 +1,112 @@
+"""Public API surface: Go-name aliases, adapter contracts, error types.
+
+The BASELINE configs exercise the reference names (Take, FromFile,
+SelectColumns, Filter, Like, Map, ToCsvFile, UniqueIndexOn, IndexOn,
+Find, Join, ResolveDuplicates) — pin that every one exists and behaves.
+"""
+
+import io
+
+import pytest
+
+import csvplus_tpu as csvplus
+from csvplus_tpu import DataSourceError, Row, Take, TakeRows
+
+
+def test_go_style_module_aliases():
+    for name in [
+        "Take", "TakeRows", "FromFile", "FromReader", "FromReadCloser",
+        "LoadIndex", "Like", "All", "Any", "Not",
+    ]:
+        assert hasattr(csvplus, name), name
+
+
+def test_go_style_method_aliases(people_csv):
+    src = Take(csvplus.FromFile(people_csv))
+    for name in [
+        "Transform", "Filter", "Map", "Validate", "Top", "Drop",
+        "TakeWhile", "DropWhile", "DropColumns", "SelectColumns",
+        "IndexOn", "UniqueIndexOn", "Join", "Except",
+        "ToCsv", "ToCsvFile", "ToJSON", "ToJSONFile", "ToRows",
+    ]:
+        assert hasattr(src, name), name
+    idx = src.IndexOn("id")
+    for name in ["Iterate", "Find", "SubIndex", "ResolveDuplicates", "WriteTo", "OnDevice"]:
+        assert hasattr(idx, name), name
+    row = Row({"a": "1"})
+    for name in [
+        "HasColumn", "SafeGetValue", "Header", "SelectExisting", "Select",
+        "SelectValues", "Clone", "ValueAsInt", "ValueAsFloat64",
+    ]:
+        assert hasattr(row, name), name
+
+
+def test_take_rejects_non_iterable_source():
+    with pytest.raises(TypeError) as e:
+        csvplus.take(42)
+    assert "iterate" in str(e.value)
+
+
+def test_take_is_idempotent_on_datasource(people_csv):
+    src = Take(csvplus.FromFile(people_csv))
+    assert csvplus.take(src) is src
+
+
+def test_from_read_closer_closes():
+    class S(io.StringIO):
+        closed_flag = False
+
+        def close(self):
+            S.closed_flag = True
+            super().close()
+
+    s = S("a,b\n1,2\n")
+    rows = Take(csvplus.from_read_closer(s)).to_rows()
+    assert rows == [Row({"a": "1", "b": "2"})]
+    assert S.closed_flag
+
+
+def test_from_reader_does_not_close():
+    s = io.StringIO("a,b\n1,2\n")
+    Take(csvplus.from_reader(s)).to_rows()
+    assert not s.closed
+
+
+def test_from_reader_accepts_str_and_bytes():
+    assert Take(csvplus.from_reader("a\nx\n")).to_rows() == [Row({"a": "x"})]
+    assert Take(csvplus.from_reader(b"a\nx\n")).to_rows() == [Row({"a": "x"})]
+
+
+def test_data_source_error_attributes():
+    try:
+        Take(csvplus.from_reader("a,b\n1\n")).to_rows()
+    except DataSourceError as e:
+        assert e.line == 2
+        assert "wrong number of fields" in str(e.err)
+    else:
+        pytest.fail("expected DataSourceError")
+
+
+def test_num_fields_applies_to_header_row():
+    with pytest.raises(DataSourceError) as e:
+        Take(csvplus.from_reader("a,b\n1,2\n").num_fields(3)).to_rows()
+    assert e.value.line == 1
+
+
+def test_row_is_a_dict():
+    r = Row({"a": "1"})
+    assert isinstance(r, dict)
+    assert {**r, "b": "2"} == {"a": "1", "b": "2"}
+    # plain dicts work as rows in sources
+    assert TakeRows([{"a": "1"}]).to_rows() == [Row({"a": "1"})]
+
+
+def test_predicates_accept_plain_dicts_and_rows():
+    like = csvplus.Like({"a": "1"})
+    assert like(Row({"a": "1"})) and like({"a": "1"})
+    assert not like({"a": "2"}) and not like({})
+
+
+def test_validate_passthrough_alias(people_csv):
+    out = Take(csvplus.FromFile(people_csv)).Validate(lambda r: None).ToRows()
+    assert len(out) == 120

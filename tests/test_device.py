@@ -526,3 +526,27 @@ def test_take_drop_while_symbolic_parity(host_people, dev_people):
     same(
         dev_people.take_while(f).to_rows(), host_people.take_while(f).to_rows()
     )
+
+
+def test_explain_shows_break_point(dev_people):
+    assert "Scan" in dev_people.explain()
+    assert "Filter" in dev_people.filter(Like({"name": "Ava"})).explain()
+    broken = dev_people.filter(lambda r: True)
+    text = broken.explain()
+    assert "host streaming" in text and "filter" in text and "not symbolic" in text
+
+
+def test_explain_host_chain(host_people):
+    assert "host streaming" in host_people.explain()
+
+
+def test_explain_note_propagates_and_covers_all_breaks(dev_people, people_csv):
+    """The break reason survives further chaining, and join/except/
+    validate record breaks too (review regression)."""
+    broken = dev_people.filter(lambda r: True).map(SetValue("a", "b")).top(3)
+    assert "filter(<lambda>) is not symbolic" in broken.explain()
+    host_idx = Take(from_file(people_csv)).unique_index_on("id")  # no device copy
+    j = dev_people.join(host_idx, "id")
+    assert "no device copy" in j.explain()
+    v = dev_people.validate(lambda r: None)
+    assert "no symbolic form" in v.explain()
