@@ -25,9 +25,12 @@ inside jit):
   retries with doubled capacity — the count -> allocate -> fill pattern
   with a geometric backoff instead of a second counting pass.
 
-Skew note: a single key whose duplicate run exceeds one shard's slice
-still lands on one shard (run-start snapping makes the slice grow); heavy
--hitter salting (JSPIM-style) is future work and documented as such.
+Skew: PROBE-side heavy hitters are short-circuited before the exchange
+(sampled hot keys answered once via host binary search — a lookup answer
+is constant per key), and residual imbalance is absorbed by the geometric
+capacity retry.  BUILD-side skew (one key's duplicate run exceeding a
+shard slice) still lands on one shard via run-start snapping; JSPIM-style
+salting for that case remains future work.
 """
 
 from __future__ import annotations
@@ -102,22 +105,27 @@ def _probe_shard_kernel(n_shards: int, capacity: int, qk, keys_local, splits, ba
 
     valid = qk >= 0
     dest = jnp.clip(jnp.searchsorted(splits, qk, side="right") - 1, 0, N - 1)
-    dest = jnp.where(valid, dest, 0).astype(jnp.int32)
+    # invalid probes (absent keys / hot-key short-circuited) get dest N:
+    # they sort to the end, consume NO exchange slots, and answer (−1, 0)
+    dest = jnp.where(valid, dest, N).astype(jnp.int32)
 
     # stable sort by destination, carrying the key and original position
     pos = jnp.arange(m, dtype=jnp.int32)
     dest_s, qk_s, pos_s = lax.sort((dest, qk, pos), num_keys=1, is_stable=True)
+    routed = dest_s < N
 
-    # rank of each query within its destination group
-    group_start = jnp.searchsorted(dest_s, jnp.arange(N, dtype=jnp.int32), side="left")
-    rank = jnp.arange(m, dtype=jnp.int32) - group_start[dest_s]
-    ok = rank < C  # overflow -> sentinel result, caller retries bigger C
-
-    # scatter into (N, C) slot buffer; overflowing ranks drop out of bounds
-    buf = jnp.full((N, C), -1, dtype=jnp.int32)
-    buf = buf.at[dest_s, jnp.where(ok, rank, C)].set(
-        jnp.where(valid[pos_s], qk_s, -1), mode="drop"
+    # rank of each query within its destination group; dest_s is in
+    # [0, N] by construction (clip for valid, N for invalid)
+    group_start = jnp.searchsorted(
+        dest_s, jnp.arange(N + 1, dtype=jnp.int32), side="left"
     )
+    rank = jnp.arange(m, dtype=jnp.int32) - group_start[dest_s]
+    ok = routed & (rank < C)  # overflow -> sentinel, caller retries bigger C
+    safe_dest = jnp.minimum(dest_s, N - 1)  # N (invalid) is dropped via ok
+
+    # scatter into (N, C) slot buffer; overflow/invalid drop out of bounds
+    buf = jnp.full((N, C), -1, dtype=jnp.int32)
+    buf = buf.at[safe_dest, jnp.where(ok, rank, C)].set(qk_s, mode="drop")
 
     # ICI shuffle: slot-aligned exchange
     recv = lax.all_to_all(buf, AXIS, split_axis=0, concat_axis=0, tiled=True)
@@ -139,8 +147,12 @@ def _probe_shard_kernel(n_shards: int, capacity: int, qk, keys_local, splits, ba
         resp_ct.reshape(N, C), AXIS, split_axis=0, concat_axis=0, tiled=True
     )
 
-    got_lo = jnp.where(ok, back_lo[dest_s, jnp.minimum(rank, C - 1)], -1)
-    got_ct = jnp.where(ok, back_ct[dest_s, jnp.minimum(rank, C - 1)], -1)
+    safe_rank = jnp.clip(rank, 0, C - 1)
+    got_lo = jnp.where(ok, back_lo[safe_dest, safe_rank], -1)
+    # invalid probes answer (lo=-1, ct=0); only routed overflow gets -1
+    got_ct = jnp.where(
+        routed, jnp.where(ok, back_ct[safe_dest, safe_rank], -1), 0
+    )
 
     # un-permute to original local order
     out_lo = jnp.zeros(m, jnp.int32).at[pos_s].set(got_lo)
@@ -194,7 +206,37 @@ def partitioned_probe(
         prepared = prepare_partitioned(mesh, index_keys_sorted)
     keys_dev, splits_dev, base_dev = prepared
 
-    qk, true_len = pad_to_multiple(stream_keys.astype(np.int32), n_shards, np.int32(-1))
+    stream_keys = stream_keys.astype(np.int32)
+
+    # --- probe-side skew: hot-key short circuit --------------------------
+    # A heavy-hitter probe key routes its whole mass to one owner shard
+    # and inflates the slot capacity.  But a lookup answer is CONSTANT per
+    # key, so: sample the probe keys, detect heavy values, answer them
+    # once with a host binary search over the (host-resident) sorted build
+    # keys, and send only the cold keys through the exchange.
+    hot_mask = None
+    hot_lo = hot_ct = None
+    if stream_keys.size >= 4 * n_shards and index_keys_sorted.size:
+        step = max(1, stream_keys.size // 4096)
+        sample = stream_keys[::step]
+        sample = sample[sample >= 0]
+        if sample.size:
+            vals, cnts = np.unique(sample, return_counts=True)
+            # "heavy" = would overfill its owner's fair share of slots
+            thresh = max(8, sample.size // (4 * n_shards))
+            hot = vals[cnts >= thresh]
+            if hot.size:
+                h_lo = np.searchsorted(index_keys_sorted, hot, side="left")
+                h_hi = np.searchsorted(index_keys_sorted, hot, side="right")
+                idx = np.searchsorted(hot, stream_keys)
+                idx_c = np.minimum(idx, hot.size - 1)
+                hot_mask = hot[idx_c] == stream_keys
+                pos = idx_c[hot_mask]
+                hot_lo = h_lo[pos].astype(np.int32)
+                hot_ct = (h_hi - h_lo)[pos].astype(np.int32)
+                stream_keys = np.where(hot_mask, np.int32(-1), stream_keys)
+
+    qk, true_len = pad_to_multiple(stream_keys, n_shards, np.int32(-1))
     m_per_shard = qk.shape[0] // n_shards
     if capacity is None:
         # expect near-uniform routing; retry doubles on skew overflow
@@ -209,10 +251,16 @@ def partitioned_probe(
         )
         ct_np = np.asarray(ct)
         if not (ct_np < 0).any():
-            return np.asarray(lo)[:true_len], ct_np[:true_len]
+            lo_np, ct_np = np.asarray(lo)[:true_len], ct_np[:true_len]
+            if hot_mask is not None:
+                lo_np = lo_np.copy()
+                ct_np = ct_np.copy()
+                lo_np[hot_mask] = np.where(hot_ct > 0, hot_lo, -1)
+                ct_np[hot_mask] = hot_ct
+            return lo_np, ct_np
         if capacity >= qk.shape[0]:
             raise RuntimeError("partitioned_probe: capacity overflow at maximum")
-        capacity *= 2  # skewed routing: geometric retry
+        capacity *= 2  # residual skew: geometric retry backstop
 
 
 @jax.jit

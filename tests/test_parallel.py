@@ -67,6 +67,28 @@ def test_partitioned_probe_differential(mesh):
 
 
 def test_partitioned_probe_skew_retry(mesh):
+    """The geometric capacity retry engages for moderate multi-key skew
+    that stays BELOW the hot-key sampling threshold (explicit capacity=64
+    start), and results stay exact."""
+    rng = np.random.default_rng(3)
+    keys = np.sort(rng.integers(0, 100_000, size=40_000).astype(np.int32))
+    # 500 distinct moderately-repeated keys: none individually hot, but
+    # together they overload single-destination slots at capacity=64
+    repeats = rng.choice(keys, 500, replace=False)
+    queries = np.concatenate(
+        [np.repeat(repeats, 30), rng.integers(0, 110_000, 15_000).astype(np.int32)]
+    ).astype(np.int32)
+    rng.shuffle(queries)
+    lo, ct = partitioned_probe(mesh, queries, keys, capacity=64)
+    olo = np.searchsorted(keys, queries, side="left")
+    oct_ = np.searchsorted(keys, queries, side="right") - olo
+    assert (ct == oct_).all()
+    hit = ct > 0
+    assert (lo[hit] == olo[hit]).all()
+
+
+def test_partitioned_probe_single_heavy_key(mesh):
+    """A single fully-heavy key is absorbed by the hot-key cache."""
     rng = np.random.default_rng(3)
     keys = np.sort(rng.integers(0, 1000, size=8_000).astype(np.int32))
     heavy = np.full(4_000, keys[50], dtype=np.int32)
@@ -331,3 +353,35 @@ def test_executor_join_partitioned_path(people_csv, orders_csv, monkeypatch):
     assert dev2 == host_rows and calls["n"] == n0
     # prefix probes (Find) keep using broadcast and stay correct
     assert cust.find("55").to_rows() == [r for r in Take(cust) if r["id"] == "55"]
+
+
+def test_partitioned_probe_hot_key_short_circuit(mesh, monkeypatch):
+    """Heavy probe keys are answered via the sampled hot-key cache: one
+    SPMD call (no capacity retries), exact results on a hot/cold mix."""
+    import csvplus_tpu.parallel.pjoin as PJ
+
+    calls = {"n": 0}
+    orig = PJ._probe_spmd
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(PJ, "_probe_spmd", counting)
+
+    rng = np.random.default_rng(9)
+    keys = np.sort(rng.integers(0, 2000, size=16_000).astype(np.int32))
+    heavy_val = keys[777]
+    cold = rng.integers(-5, 2500, size=6_000).astype(np.int32)
+    cold[cold < 0] = -1
+    queries = np.concatenate([np.full(10_000, heavy_val, np.int32), cold])
+    rng.shuffle(queries)
+
+    lo, ct = PJ.partitioned_probe(mesh, queries, keys)
+    olo = np.searchsorted(keys, queries, side="left")
+    oct_ = np.searchsorted(keys, queries, side="right") - olo
+    oct_[queries < 0] = 0
+    assert (ct == oct_).all()
+    hit = ct > 0
+    assert (lo[hit] == olo[hit]).all()
+    assert calls["n"] == 1  # hot keys bypassed routing; no retry needed
