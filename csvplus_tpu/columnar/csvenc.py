@@ -20,7 +20,7 @@ cells / missing columns), so behavior parity is preserved.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 

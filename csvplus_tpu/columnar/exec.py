@@ -29,7 +29,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import plan as P
-from ..errors import DataSourceError, StopPipeline
+from ..errors import DataSourceError
 from ..row import MissingColumnError, Row
 from .table import DeviceTable, StringColumn
 

@@ -16,8 +16,6 @@ record parser entirely.
 
 from __future__ import annotations
 
-from typing import Optional
-
 from ..source import DataSource
 from .table import DeviceTable
 

@@ -20,7 +20,6 @@ from typing import Dict
 import jax.numpy as jnp
 
 from ..predicates import All, Any_, Like, Not
-from .. import predicates
 from ..columnar.table import StringColumn, lookup_code
 
 

@@ -14,7 +14,7 @@ host operations by design.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
 import numpy as np
 
@@ -24,7 +24,7 @@ from jax.sharding import Mesh
 
 from ..columnar.table import DeviceTable, StringColumn, encode_strings
 from ..row import Row
-from .mesh import AXIS, pad_to_multiple, replicate, shard_rows
+from .mesh import pad_to_multiple, shard_rows
 
 
 class ShardedTable:

@@ -17,7 +17,7 @@ upstream plan is ``None`` or the stage argument is not symbolic.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Optional, Sequence, Tuple
 
 

@@ -22,13 +22,12 @@ to the reference's (csvplus_test.go:808-909).
 from __future__ import annotations
 
 import io
-import os
 from typing import Callable, Dict, Iterator, List, Optional, TextIO, Tuple
 
 from .csvio import ERR_FIELD_COUNT, CsvParseError, parse_records
 from .errors import DataSourceError, StopPipeline, map_error
 from .row import Row
-from .source import DataSource, RowFunc
+from .source import RowFunc
 
 # a maker opens the input and returns (stream, closer) — csvplus.go:933
 Maker = Callable[[], Tuple[TextIO, Callable[[], None]]]

@@ -19,7 +19,6 @@ import os
 from typing import IO, List
 
 from .csvio import write_record
-from .errors import StopPipeline
 from .row import Row
 
 
