@@ -46,6 +46,25 @@ def reader_to_device(
     from ..utils.observe import telemetry
 
     path = getattr(reader, "_path", None)
+    if path is not None and _device_parse_enabled():
+        try:
+            from ..native import scanner as _sc
+
+            with telemetry.stage("ingest:device-parsed", 0) as _t:
+                enc = _sc.read_device_parsed_columns(reader, path)
+                if enc is not None:
+                    names, data = enc
+                    nrows = data[names[0]][1].shape[0] if names else 0
+                    table = DeviceTable.from_encoded(
+                        {n: data[n] for n in names}, nrows, device=device
+                    )
+                    _t["rows_out"] = nrows
+                else:
+                    _t["discard"] = True
+            if enc is not None:
+                return source_from_table(_maybe_shard(table, shards, mesh))
+        except ImportError:
+            pass
     if path is not None:
         try:
             from ..native import scanner
@@ -70,6 +89,20 @@ def reader_to_device(
         table = DeviceTable.from_pylists({n: data[n] for n in names}, device=device)
         _t["rows_out"] = table.nrows
     return source_from_table(_maybe_shard(table, shards, mesh))
+
+
+def _device_parse_enabled() -> bool:
+    """The fully-on-device parse tier: default-on when the default backend
+    is an accelerator (where the bytes would travel there anyway), opt-in
+    via CSVPLUS_DEVICE_PARSE=1 elsewhere, opt-out with =0."""
+    import os
+
+    flag = os.environ.get("CSVPLUS_DEVICE_PARSE")
+    if flag is not None:
+        return flag == "1"
+    import jax
+
+    return jax.default_backend() not in ("cpu",)
 
 
 def _maybe_shard(table: DeviceTable, shards, mesh) -> DeviceTable:

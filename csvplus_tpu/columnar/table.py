@@ -230,7 +230,10 @@ class DeviceTable:
         ((dictionary, codes) pairs, e.g. the native ingest fast path)."""
         dev = default_device(device)
         cols = {
-            name: StringColumn(dictionary, jax.device_put(codes, dev))
+            name: StringColumn(
+                dictionary,
+                codes if isinstance(codes, jax.Array) else jax.device_put(codes, dev),
+            )
             for name, (dictionary, codes) in data.items()
         }
         return cls(cols, nrows, dev)

@@ -290,6 +290,86 @@ def _column_positions(data_counts, field_offset, header, rec_base, pad_allowed):
         yield name, pos, ok
 
 
+def read_device_parsed_columns(reader, path: str):
+    """FULLY device-side ingest tier: byte scan, field offsets, and
+    dictionary encoding all run as JAX kernels (ops/parse.py); the host
+    only resolves the header and decodes unique dictionary values.
+
+    Simple rectangular CSV only (no quotes/CR/comments/blank lines);
+    returns (names, {name: (dictionary, codes)}) or None to fall back.
+    """
+    if (
+        reader._trim_leading_space
+        or reader._comment is not None
+        or len(reader._delimiter.encode("utf-8")) != 1
+    ):
+        return None
+    from ..ops.parse import encode_column_device, parse_simple_csv_device
+
+    with open(path, "rb") as f:
+        data = f.read()
+    parsed = parse_simple_csv_device(data, reader._delimiter)
+    if parsed is None:
+        return None
+    starts, lens, counts, data_dev = parsed
+
+    header, rec_base, field_offset, data_counts = _resolve_header_from_arrays(
+        reader, data, b"", starts, lens, counts
+    )
+
+    combined = np.frombuffer(data, dtype=np.uint8)
+    out = {}
+    pad_allowed = reader._num_fields < 0
+    for name, pos, ok in _column_positions(
+        data_counts, field_offset, header, rec_base, pad_allowed
+    ):
+        col_starts = np.where(ok, starts[np.where(ok, pos, 0)], 0)
+        col_lens = np.where(ok, lens[np.where(ok, pos, 0)], 0).astype(np.int32)
+        enc = encode_column_device(data_dev, data, col_starts, col_lens)
+        if enc is None:  # wide fields: vectorized host encode on the same offsets
+            enc = encode_fields_vectorized(combined, col_starts, col_lens)
+        if enc is None:
+            return None
+        out[name] = enc
+    return list(header), out
+
+
+def _resolve_header_from_arrays(reader, data, scratch, starts, lens, counts):
+    """Header + field-count policy over pre-scanned offset arrays — the
+    single implementation behind _scan_for_reader (native tiers) and the
+    device-parsed tier.  Raises DataSourceError; never returns None."""
+    nrec = counts.shape[0]
+    expected = reader._num_fields
+    if reader._header_from_first_row:
+        if nrec == 0:
+            raise DataSourceError(1, "EOF")
+        first_n = int(counts[0])
+        if expected == 0:
+            expected = first_n
+        elif expected > 0 and first_n != expected:
+            raise DataSourceError(1, ERR_FIELD_COUNT)
+        first = [
+            _field_str(data, scratch, int(starts[i]), int(lens[i]))
+            for i in range(first_n)
+        ]
+        header = reader._make_header(first, 1)
+        rec_base = 2
+        field_offset = first_n
+        data_counts = counts[1:]
+    else:
+        header = dict(reader._header or {})
+        rec_base = 1
+        field_offset = 0
+        data_counts = counts
+    if reader._num_fields >= 0 and data_counts.shape[0]:
+        if expected == 0:
+            expected = int(data_counts[0])
+        bad = np.flatnonzero(data_counts != expected)
+        if bad.size:
+            raise DataSourceError(int(bad[0]) + rec_base, ERR_FIELD_COUNT)
+    return header, rec_base, field_offset, data_counts
+
+
 def read_encoded_columns_native(reader, path: str):
     """Columnar ingest fast path: parse natively AND dictionary-encode
     each selected column vectorized — no per-cell Python strings.
@@ -342,38 +422,9 @@ def _scan_for_reader(reader, path: str):
         comment=reader._comment,
         lazy_quotes=reader._lazy_quotes,
     )
-
-    nrec = counts.shape[0]
-    expected = reader._num_fields
-    if reader._header_from_first_row:
-        if nrec == 0:
-            raise DataSourceError(1, "EOF")
-        first_n = int(counts[0])
-        if expected == 0:
-            expected = first_n
-        elif expected > 0 and first_n != expected:
-            raise DataSourceError(1, ERR_FIELD_COUNT)
-        first = [
-            _field_str(data, scratch, int(starts[i]), int(lens[i]))
-            for i in range(first_n)
-        ]
-        header = reader._make_header(first, 1)
-        rec_base = 2
-        field_offset = first_n
-        data_counts = counts[1:]
-    else:
-        header = dict(reader._header or {})
-        rec_base = 1
-        field_offset = 0
-        data_counts = counts
-
-    if reader._num_fields >= 0 and data_counts.shape[0]:
-        if expected == 0:
-            expected = int(data_counts[0])
-        bad = np.flatnonzero(data_counts != expected)
-        if bad.size:
-            raise DataSourceError(int(bad[0]) + rec_base, ERR_FIELD_COUNT)
-
+    header, rec_base, field_offset, _ = _resolve_header_from_arrays(
+        reader, data, scratch, starts, lens, counts
+    )
     return data, starts, lens, counts, scratch, header, rec_base, field_offset
 
 

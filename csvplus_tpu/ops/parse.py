@@ -1,0 +1,186 @@
+"""Device-side CSV parsing: bytes as u8 tensors (SURVEY.md §7 hard part 1).
+
+TPUs have no string ops, but a CSV chunk is just a ``uint8[n]`` tensor:
+
+* separators are vectorized compares (``data == ','``, ``data == '\\n'``);
+* field offsets fall out of one ``sum`` (host sync for the count — the
+  only data-dependent allocation) plus ``nonzero`` with a static size;
+* per-record field counts are differences of the delimiter prefix-sum
+  sampled at newline positions;
+* **dictionary encoding happens on device too**: fields (<= 8 bytes) are
+  gathered into NUL-padded byte matrices and packed big-endian into two
+  int32 lanes (sign-flipped so signed compare == byte order), a two-key
+  stable ``lax.sort`` groups equal fields, run boundaries become dense
+  ranks via a cumulative sum, and a scatter returns codes in row order.
+  Only the (few) unique values are ever touched by the host, to build
+  the sorted string dictionary.
+
+Scope (the honest fast path, per SURVEY's strategy): simple rectangular
+CSV — no quotes, no comment lines, no blank interior lines, no CR — the
+shape machine-generated data-lake files overwhelmingly have.  Anything
+else falls back to the native C++ / Python scanners, which are the
+behavioral spec.  Differential tests pin equality against the Reader.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_NL = 10
+_CR = 13
+_QUOTE = 34
+_SIGN = np.int32(-0x80000000)  # sign-flip bias: signed order == byte order
+
+
+@jax.jit
+def _scan_features(data: jax.Array, delim: jax.Array):
+    """One fused pass over the byte tensor: eligibility + separator masks."""
+    nl = data == _NL
+    dl = data == delim
+    sep = nl | dl
+    n_sep = jnp.sum(sep)
+    n_nl = jnp.sum(nl)
+    return sep, nl, dl, n_sep, n_nl
+
+
+@partial(jax.jit, static_argnames=("n_sep", "n_nl", "trailing_nl"))
+def _offsets_kernel(sep, nl, dl, n_sep: int, n_nl: int, trailing_nl: bool):
+    """Field starts/ends and per-record field counts, statically sized."""
+    n = sep.shape[0]
+    sep_pos = jnp.nonzero(sep, size=n_sep)[0]
+    nl_pos = jnp.nonzero(nl, size=n_nl)[0]
+
+    n_fields = n_sep + (0 if trailing_nl else 1)
+    starts = jnp.zeros(n_fields, dtype=jnp.int32)
+    starts = starts.at[1:].set((sep_pos + 1)[: n_fields - 1].astype(jnp.int32))
+    ends = jnp.concatenate(
+        [sep_pos.astype(jnp.int32), jnp.full(1, n, jnp.int32)]
+    )[:n_fields]
+
+    # fields per record: delimiters before each newline, differenced
+    dl_cum = jnp.cumsum(dl)
+    dl_at_nl = dl_cum[nl_pos]
+    prev = jnp.concatenate([jnp.zeros(1, dl_at_nl.dtype), dl_at_nl[:-1]])
+    rec_counts = (dl_at_nl - prev + 1).astype(jnp.int32)
+    if not trailing_nl:
+        total_dl = dl_cum[-1] if n else jnp.int32(0)
+        last = (total_dl - (dl_at_nl[-1] if n_nl else 0) + 1).astype(jnp.int32)
+        rec_counts = jnp.concatenate([rec_counts, last[None]])
+    return starts, ends, rec_counts
+
+
+@partial(jax.jit, static_argnames=("width",))
+def _encode_column_kernel(data, starts, lens, width: int):
+    """Device dictionary-encode one column of fields (width <= 8 bytes).
+
+    Returns (codes in row order, number of uniques, sorted unique hi/lo
+    packs, first-row-index of each unique) — the host decodes only the
+    uniques into the string dictionary.
+    """
+    m = starts.shape[0]
+    idx = starts[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+    mask = jnp.arange(width, dtype=jnp.int32)[None, :] < lens[:, None]
+    safe = jnp.clip(idx, 0, data.shape[0] - 1)
+    mat = jnp.where(mask, jnp.take(data, safe, axis=0), 0).astype(jnp.int32)
+
+    hw = min(4, width)
+    hi = jnp.zeros(m, dtype=jnp.int32)
+    for b in range(hw):
+        hi = hi | (mat[:, b] << (8 * (3 - b)))
+    lo = jnp.zeros(m, dtype=jnp.int32)
+    for b in range(4, width):
+        lo = lo | (mat[:, b] << (8 * (7 - b)))
+    hi = hi ^ _SIGN  # signed compare now equals byte-lexicographic order
+    lo = lo ^ _SIGN
+
+    pos = jnp.arange(m, dtype=jnp.int32)
+    hi_s, lo_s, pos_s = jax.lax.sort((hi, lo, pos), num_keys=2, is_stable=True)
+
+    new_run = jnp.concatenate(
+        [jnp.ones(1, bool), (hi_s[1:] != hi_s[:-1]) | (lo_s[1:] != lo_s[:-1])]
+    )
+    rank = jnp.cumsum(new_run) - 1  # dense code per sorted position
+    codes = jnp.zeros(m, dtype=jnp.int32).at[pos_s].set(rank.astype(jnp.int32))
+    n_uniq = rank[-1] + 1 if m else jnp.int32(0)
+    # first sorted occurrence of each unique -> original row index
+    uniq_rows = jnp.where(new_run, pos_s, m)  # m = +inf for segment mins
+    uniq_first = jnp.full(m, m, jnp.int32).at[rank].min(uniq_rows)
+    return codes, n_uniq, uniq_first
+
+
+def parse_simple_csv_device(
+    data: bytes, delimiter: str = ",", device=None
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, jax.Array]]:
+    """Device scan of a simple CSV chunk.
+
+    Returns (field_starts, field_lens, rec_counts, device u8 data) as in
+    the native scanner's contract, or None when the chunk needs the
+    full state machine (quotes / CR / blank lines / empty).
+    """
+    if not data:
+        return None
+    if len(data) >= 2**31:
+        return None  # int32 offsets would wrap; the int64 scanners handle it
+    # eligibility checks on host bytes (memchr-cheap) BEFORE any upload:
+    # quotes/CR need the full state machine, NUL aliases encode padding,
+    # blank lines change record numbering
+    if (
+        b'"' in data
+        or b"\r" in data
+        or b"\x00" in data
+        or b"\n\n" in data
+        or data.startswith(b"\n")
+    ):
+        return None
+    arr = jax.device_put(np.frombuffer(data, dtype=np.uint8), device)
+    sep, nl, dl, n_sep, n_nl = _scan_features(arr, jnp.uint8(ord(delimiter)))
+    trailing_nl = data.endswith(b"\n")
+    starts, ends, rec_counts = _offsets_kernel(
+        sep, nl, dl, int(n_sep), int(n_nl), trailing_nl
+    )
+    starts_np = np.asarray(starts, dtype=np.int64)
+    lens_np = (np.asarray(ends) - starts_np).astype(np.int32)
+    return starts_np, lens_np, np.asarray(rec_counts), arr
+
+
+_DEVICE_ENCODE_MAX_LEN = 8
+
+
+def encode_column_device(
+    data_dev: jax.Array,
+    data_host: bytes,
+    starts: np.ndarray,
+    lens: np.ndarray,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Fully-device dictionary encode of one column (fields <= 8 bytes).
+
+    Returns (sorted bytes dictionary, int32 codes) matching
+    encode_strings' contract, or None for wider fields.
+    """
+    if starts.shape[0] == 0:
+        return np.empty(0, dtype="S1"), np.empty(0, dtype=np.int32)
+    width = int(lens.max())
+    if width > _DEVICE_ENCODE_MAX_LEN:
+        return None
+    width = max(width, 1)
+    codes, n_uniq, uniq_first = _encode_column_kernel(
+        data_dev,
+        jnp.asarray(starts, dtype=jnp.int32),
+        jnp.asarray(lens, dtype=jnp.int32),
+        width,
+    )
+    k = int(n_uniq)
+    rows = np.asarray(uniq_first)[:k]
+    # host touches only the unique values to build the dictionary
+    dictionary = np.array(
+        [data_host[starts[r] : starts[r] + lens[r]] for r in rows], dtype="S"
+    )
+    if dictionary.size == 0:
+        dictionary = np.empty(0, dtype="S1")
+    return dictionary, codes  # codes stay on device; no host round-trip

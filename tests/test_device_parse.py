@@ -1,0 +1,110 @@
+"""Device-side CSV parse + device dictionary encode: differential vs the
+Reader (the behavioral spec)."""
+
+import numpy as np
+import pytest
+
+from csvplus_tpu import Row, Take, from_file
+from csvplus_tpu.native import scanner
+from csvplus_tpu.ops.parse import (
+    encode_column_device,
+    parse_simple_csv_device,
+)
+
+
+def _decode(enc):
+    out = {}
+    names, data = enc
+    for n in names:
+        d, c = data[n]
+        ds = np.char.decode(d, "utf-8") if d.dtype.kind == "S" else d
+        out[n] = ds[c].tolist()
+    return names, out
+
+
+def test_device_parse_matches_reader(people_csv, orders_csv):
+    for path in (people_csv, orders_csv):
+        enc = scanner.read_device_parsed_columns(from_file(path), path)
+        assert enc is not None
+        names, got = _decode(enc)
+        want_names, want = from_file(path).read_columns()
+        assert names == want_names and got == want
+
+
+def test_device_parse_select_columns(orders_csv):
+    mk = lambda: from_file(orders_csv).select_columns("cust_id", "qty")
+    enc = scanner.read_device_parsed_columns(mk(), orders_csv)
+    assert enc is not None
+    _, got = _decode(enc)
+    assert got == mk().read_columns()[1]
+
+
+@pytest.mark.parametrize(
+    "text",
+    [
+        'a,b\n"q",2\n',  # quotes -> fallback
+        "a,b\r\n1,2\r\n",  # CR -> fallback
+        "a,b\n\n1,2\n",  # blank line -> fallback
+        "",  # empty -> fallback
+    ],
+)
+def test_device_parse_falls_back(tmp_path, text):
+    p = tmp_path / "t.csv"
+    p.write_bytes(text.encode())
+    assert scanner.read_device_parsed_columns(from_file(str(p)), str(p)) is None
+
+
+def test_device_parse_no_trailing_newline(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("a,b\n1,2\n3,44")
+    enc = scanner.read_device_parsed_columns(from_file(str(p)), str(p))
+    _, got = _decode(enc)
+    assert got == {"a": ["1", "3"], "b": ["2", "44"]}
+
+
+def test_device_parse_ragged_field_count_error(tmp_path):
+    from csvplus_tpu import DataSourceError
+
+    p = tmp_path / "t.csv"
+    p.write_text("a,b\n1,2\n1,2,3\n")
+    with pytest.raises(DataSourceError) as e:
+        scanner.read_device_parsed_columns(from_file(str(p)), str(p))
+    assert str(e.value) == "row 3: wrong number of fields"
+
+
+def test_device_encode_column_matches_host(tmp_path):
+    rng = np.random.default_rng(6)
+    vals = [f"v{int(x)}" for x in rng.integers(0, 500, 20_000)]
+    text = "k\n" + "\n".join(vals) + "\n"
+    p = tmp_path / "t.csv"
+    p.write_bytes(text.encode())
+    enc = scanner.read_device_parsed_columns(from_file(str(p)), str(p))
+    _, got = _decode(enc)
+    assert got["k"] == vals
+    # dictionary is sorted byte-lex like the host encoder
+    d, c = enc[1]["k"]
+    assert (np.sort(d) == d).all()
+
+
+def test_device_encode_wide_fields_fall_back_to_host_encode(tmp_path):
+    vals = ["short", "a-rather-long-value-over-8-bytes", "mid"]
+    p = tmp_path / "t.csv"
+    p.write_text("k\n" + "\n".join(vals) + "\n")
+    enc = scanner.read_device_parsed_columns(from_file(str(p)), str(p))
+    assert enc is not None  # wide column used the host vectorized encode
+    _, got = _decode(enc)
+    assert got["k"] == vals
+
+
+def test_ondevice_pipeline_through_device_parse(people_csv, monkeypatch):
+    """End-to-end OnDevice with the tier forced on == host oracle."""
+    monkeypatch.setenv("CSVPLUS_DEVICE_PARSE", "1")
+    from csvplus_tpu import Like
+
+    dev = from_file(people_csv).on_device("cpu")
+    host = Take(from_file(people_csv))
+    assert dev.to_rows() == host.to_rows()
+    p = Like({"name": "Amelia", "surname": "Jones"})
+    assert dev.filter(p).to_rows() == host.filter(p).to_rows()
+    idx = dev.index_on("surname", "name")
+    assert Take(idx).to_rows() == Take(host.index_on("surname", "name")).to_rows()
