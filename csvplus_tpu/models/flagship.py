@@ -102,9 +102,15 @@ class ThreewayJoin:
         )
 
     def step(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
-        """The fused probe step (jit-compiled, device-resident)."""
+        """The fused probe step (jit-compiled, device-resident).
+
+        Key arrays go through the broadcast-replication cache so a mesh-
+        sharded stream probes replicated keys (no device mixing)."""
         return threeway_step(
-            self.cust.packed_i32, self.prod.packed_i32, self.qk_cust, self.qk_prod
+            self.cust._keys_for(self.qk_cust),
+            self.prod._keys_for(self.qk_prod),
+            self.qk_cust,
+            self.qk_prod,
         )
 
     def run(self) -> DeviceTable:
@@ -115,27 +121,53 @@ class ThreewayJoin:
         name collision; stream row order is preserved.
         """
         lo_c, lo_p, valid = self.step()
-        valid_np = np.asarray(valid)
-        sel = np.flatnonzero(valid_np)
-        sel_dev = jnp.asarray(sel, dtype=jnp.int32)
-
-        ids_c = jnp.take(lo_c, sel_dev, axis=0)
-        ids_p = jnp.take(lo_p, sel_dev, axis=0)
-
-        # one fused gather call per side (compiled once per shape)
         names_c = list(self.cust.table.columns)
         names_p = list(self.prod.table.columns)
         names_o = list(self.orders_cols)
-        ones = jnp.ones(sel.shape[0], dtype=bool)
-        g_c = gather_columns(
-            ids_c, ones, *(self.cust.table.columns[n].codes for n in names_c)
-        )
-        g_p = gather_columns(
-            ids_p, ones, *(self.prod.table.columns[n].codes for n in names_p)
-        )
-        g_o = gather_columns(
-            sel_dev, ones, *(self.orders_cols[n].codes for n in names_o)
-        )
+
+        # A padded stream layout (mesh-sharded tables pad codes beyond
+        # nrows) must take the compaction path: probe arrays are padded-
+        # length there.  The scalar probe costs one extra tiny sync on
+        # the partial-match path, but saves transferring the full bool
+        # mask (nrows bytes) in the common all-matched case.
+        unpadded = int(lo_c.shape[0]) == self.n_orders
+        n_valid = int(jnp.sum(valid)) if unpadded else -1  # scalar sync
+        if n_valid == self.n_orders:
+            # every stream row matched (the referential-integrity common
+            # case): no compaction — gather build attributes by the probe
+            # ids directly and pass stream columns through untouched
+            ids_c, ids_p = lo_c, lo_p
+            ones = jnp.ones(self.n_orders, dtype=bool)
+            g_c = gather_columns(
+                ids_c, ones, *(self.cust.table.columns[n].codes for n in names_c)
+            )
+            g_p = gather_columns(
+                ids_p, ones, *(self.prod.table.columns[n].codes for n in names_p)
+            )
+            g_o = tuple(self.orders_cols[n].codes for n in names_o)
+            n_out = self.n_orders
+        else:
+            # compaction path (unmatched rows or padded/sharded stream):
+            # resolve the data-dependent selection on host, where mixing
+            # sharded and unsharded operands is a non-issue; one upload
+            # per output column puts the compacted result back on device
+            valid_np = np.asarray(valid)
+            sel = np.flatnonzero(valid_np)
+            ids_c = np.asarray(lo_c)[sel]
+            ids_p = np.asarray(lo_p)[sel]
+            g_c = tuple(
+                jnp.asarray(np.asarray(self.cust.table.columns[n].codes)[ids_c])
+                for n in names_c
+            )
+            g_p = tuple(
+                jnp.asarray(np.asarray(self.prod.table.columns[n].codes)[ids_p])
+                for n in names_p
+            )
+            g_o = tuple(
+                jnp.asarray(np.asarray(self.orders_cols[n].codes)[sel])
+                for n in names_o
+            )
+            n_out = int(sel.shape[0])
 
         out: Dict[str, StringColumn] = {}
         for name, codes in zip(names_c, g_c):
@@ -145,7 +177,7 @@ class ThreewayJoin:
         for name, codes in zip(names_o, g_o):  # stream wins
             out[name] = StringColumn(self.orders_cols[name].dictionary, codes)
         device = next(iter(out.values())).codes.device if out else None
-        return DeviceTable(out, int(sel.shape[0]), device)
+        return DeviceTable(out, n_out, device)
 
 
 def example_step_args(n_orders: int = 4096, n_cust: int = 512, n_prod: int = 64):

@@ -191,3 +191,18 @@ def stock_csv(corpus) -> str:
 @pytest.fixture()
 def orders_csv(corpus) -> str:
     return corpus["orders_csv"]
+
+
+# hypothesis scale knob: CSVPLUS_HYPOTHESIS_EXAMPLES=N runs the property
+# suites at N examples (soak testing); the default "ci" profile stays
+# fast.  Per-test @settings must NOT pin max_examples or they would
+# override these profiles.
+import hypothesis as _hyp
+
+_hyp.settings.register_profile("ci", max_examples=100, deadline=None)
+_n = os.environ.get("CSVPLUS_HYPOTHESIS_EXAMPLES")
+if _n:
+    _hyp.settings.register_profile("soak", max_examples=int(_n), deadline=None)
+    _hyp.settings.load_profile("soak")
+else:
+    _hyp.settings.load_profile("ci")

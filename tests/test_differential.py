@@ -7,7 +7,7 @@ they exercise the device executor (opaque callbacks would just fall back
 to the oracle itself)."""
 
 import pytest
-from hypothesis import given, settings
+from hypothesis import given
 from hypothesis import strategies as st
 
 from csvplus_tpu import (
@@ -101,7 +101,6 @@ def run_either(src, pipeline):
         return ("error", str(e.err if hasattr(e, "err") else e))
 
 
-@settings(max_examples=120, deadline=None)
 @given(tables(), st.lists(stages(), min_size=0, max_size=4))
 def test_random_pipeline_device_matches_host(rows, pipeline):
     host = run_either(take_rows(rows), pipeline)
@@ -116,7 +115,6 @@ def test_random_pipeline_device_matches_host(rows, pipeline):
         assert dev[1].split(":")[-1].strip() in host[1] or host[1].split(":")[-1].strip() in dev[1]
 
 
-@settings(max_examples=60, deadline=None)
 @given(tables(min_rows=0, max_rows=30), st.sampled_from([("a",), ("a", "b")]))
 def test_random_index_build_device_matches_host(rows, key):
     if not all(all(k in r for k in key) for r in rows):
@@ -130,7 +128,6 @@ def test_random_index_build_device_matches_host(rows, key):
         assert dev_idx.find(probe).to_rows() == host_idx.find(probe).to_rows()
 
 
-@settings(max_examples=60, deadline=None)
 @given(tables(min_rows=1, max_rows=20), tables(min_rows=0, max_rows=20))
 def test_random_join_device_matches_host(index_rows, stream_rows):
     if not all("a" in r for r in index_rows):
@@ -150,7 +147,6 @@ def test_random_join_device_matches_host(index_rows, stream_rows):
         assert dev[0] == "error"
 
 
-@settings(max_examples=40, deadline=None)
 @given(tables(min_rows=0, max_rows=25))
 def test_random_dedup_policies_match(rows):
     if not all("a" in r for r in rows):

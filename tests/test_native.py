@@ -4,7 +4,7 @@
 import io
 
 import pytest
-from hypothesis import given, settings
+from hypothesis import given
 from hypothesis import strategies as st
 
 from csvplus_tpu import DataSourceError, Take, from_file
@@ -101,7 +101,6 @@ def _to_csv(rows):
     return "".join(",".join(q(f) for f in r) + "\n" for r in rows)
 
 
-@settings(max_examples=150, deadline=None)
 @given(
     st.lists(
         st.lists(_field, min_size=1, max_size=5),
@@ -114,7 +113,6 @@ def test_native_hypothesis_roundtrip(rows):
     assert native_records(text) == python_records(text)
 
 
-@settings(max_examples=60, deadline=None)
 @given(st.text(max_size=60))
 def test_native_hypothesis_arbitrary_text(text):
     """Arbitrary (possibly malformed) input: both parsers agree on either
@@ -218,7 +216,6 @@ def test_encoded_tier_padded_missing_columns(tmp_path):
     assert got == mk().read_columns()[1]
 
 
-@settings(max_examples=100, deadline=None)
 @given(
     st.lists(
         st.lists(_field, min_size=2, max_size=4),

@@ -385,3 +385,60 @@ def test_partitioned_probe_hot_key_short_circuit(mesh, monkeypatch):
     hit = ct > 0
     assert (lo[hit] == olo[hit]).all()
     assert calls["n"] == 1  # hot keys bypassed routing; no retry needed
+
+
+def test_flagship_partial_matches(people_csv, stock_csv):
+    """Flagship run() with unmatched stream keys compacts exactly like
+    the host join (the non-all-valid path)."""
+    from csvplus_tpu import Row, Take, TakeRows, from_file
+    from csvplus_tpu.columnar.exec import execute_plan
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+    from csvplus_tpu.models.flagship import ThreewayJoin
+
+    orders_rows = [
+        Row({"cust_id": "5", "prod_id": "1", "qty": "2"}),
+        Row({"cust_id": "99999", "prod_id": "1", "qty": "3"}),  # no customer
+        Row({"cust_id": "7", "prod_id": "777", "qty": "4"}),  # no product
+        Row({"cust_id": "8", "prod_id": "0", "qty": "5"}),
+    ]
+    cust = Take(
+        from_file(people_csv).select_columns("id", "name", "surname")
+    ).unique_index_on("id")
+    prod = Take(
+        from_file(stock_csv).select_columns("prod_id", "product", "price")
+    ).unique_index_on("prod_id")
+    host = TakeRows(orders_rows).join(cust, "cust_id").join(prod).to_rows()
+    cust.on_device("cpu")
+    prod.on_device("cpu")
+    orders_t = DeviceTable.from_rows(orders_rows, device="cpu")
+    tw = ThreewayJoin.build(orders_t, cust.device_table, prod.device_table)
+    assert tw.run().to_rows() == host
+    assert len(host) == 2
+
+
+def test_flagship_padded_sharded_stream(people_csv, stock_csv, mesh):
+    """Flagship run() on a mesh-sharded (padded) orders table takes the
+    compaction path and stays exact (review regression)."""
+    from csvplus_tpu import Row, Take, TakeRows, from_file
+    from csvplus_tpu.columnar.table import DeviceTable
+    from csvplus_tpu.models.flagship import ThreewayJoin
+    from csvplus_tpu.ops.join import DeviceIndex
+    from csvplus_tpu.ops.sort import sort_table
+
+    orders_rows = [
+        Row({"cust_id": str(i % 120), "prod_id": str(i % 8), "qty": str(i)})
+        for i in range(6)  # 6 % 8 != 0 -> padding on the mesh
+    ]
+    cust = Take(
+        from_file(people_csv).select_columns("id", "name")
+    ).unique_index_on("id")
+    prod = Take(
+        from_file(stock_csv).select_columns("prod_id", "product")
+    ).unique_index_on("prod_id")
+    host = TakeRows(orders_rows).join(cust, "cust_id").join(prod).to_rows()
+    cust.on_device("cpu")
+    prod.on_device("cpu")
+    orders_t = DeviceTable.from_rows(orders_rows, device="cpu").with_sharding(mesh)
+    tw = ThreewayJoin.build(orders_t, cust.device_table, prod.device_table)
+    assert tw.run().to_rows() == host and len(host) == 6
