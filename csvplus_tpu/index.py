@@ -290,9 +290,19 @@ class Index:
         """Persist the index; the file is removed on any write error, like
         the reference's gob writer (csvplus.go:656-680).
 
-        Format: versioned JSON-lines — a header object, then one row per
-        line.  (A gob-compat shim is a non-goal; SURVEY.md §5.)
+        Two formats behind one loader (SURVEY.md §7 M5):
+
+        * a device-backed index saves **columnar** (v2): one npz with the
+          key list plus each column's dictionary and code array — no host
+          rows are ever materialized, and loading restores a lazy
+          device index;
+        * a host index (possibly heterogeneous rows) saves versioned
+          JSON-lines (v1).  (A gob-compat shim is a non-goal, SURVEY §5.)
         """
+        impl = self._impl
+        if impl.is_lazy and impl.dev is not None:
+            self._write_columnar(file_name)
+            return
         from .sinks import _write_file
 
         def dump(f):
@@ -301,19 +311,42 @@ class Index:
                     {
                         "magic": _MAGIC,
                         "version": _VERSION,
-                        "columns": self._impl.columns,
-                        "count": len(self._impl.rows),
+                        "columns": impl.columns,
+                        "count": len(impl.rows),
                     }
                 )
             )
             f.write("\n")
-            for row in self._impl.rows:
+            for row in impl.rows:
                 f.write(json.dumps(row, sort_keys=True, separators=(",", ":")))
                 f.write("\n")
 
         _write_file(file_name, dump)
 
     WriteTo = write_to
+
+    def _write_columnar(self, file_name: str) -> None:
+        table = self._impl.dev.table
+        arrays = {
+            "__meta__": np.frombuffer(
+                json.dumps(
+                    {
+                        "magic": _MAGIC,
+                        "version": 2,
+                        "key_columns": self._impl.columns,
+                        "columns": list(table.columns),
+                        "count": table.nrows,
+                    }
+                ).encode("utf-8"),
+                dtype=np.uint8,
+            )
+        }
+        for name, col in table.columns.items():
+            arrays[f"d:{name}"] = col.dictionary
+            arrays[f"c:{name}"] = np.asarray(col.codes)
+        from .sinks import _write_file
+
+        _write_file(file_name, lambda f: np.savez(f, **arrays), mode="wb")
 
     # -- device hook -------------------------------------------------------
 
@@ -336,9 +369,17 @@ class Index:
 
 def load_index(file_name: str) -> Index:
     """Load an index persisted by :meth:`Index.write_to`
-    (csvplus.go:683-705)."""
+    (csvplus.go:683-705).  Columnar (v2) files restore a device-lazy
+    index; JSONL (v1) files restore a host index."""
+    with open(file_name, "rb") as fb:
+        magic2 = fb.read(2)
+    if magic2 == b"PK":  # npz container -> columnar v2
+        return _load_columnar(file_name)
     with open(file_name, "r", encoding="utf-8") as f:
-        header = json.loads(f.readline())
+        try:
+            header = json.loads(f.readline())
+        except json.JSONDecodeError:
+            raise ValueError(f"{file_name}: not a csvplus-tpu index file") from None
         if header.get("magic") != _MAGIC:
             raise ValueError(f"{file_name}: not a csvplus-tpu index file")
         if header.get("version") != _VERSION:
@@ -352,6 +393,39 @@ def load_index(file_name: str) -> Index:
             f"({len(rows)} rows, expected {header.get('count')})"
         )
     return Index(IndexImpl(rows, header["columns"]))
+
+
+def _load_columnar(file_name: str) -> Index:
+    import zipfile
+
+    import jax
+
+    from .columnar.table import DeviceTable, StringColumn, default_device
+    from .ops.join import DeviceIndex
+
+    try:
+        with np.load(file_name) as z:
+            meta = json.loads(bytes(z["__meta__"]).decode("utf-8"))
+            if meta.get("magic") != _MAGIC:
+                raise ValueError(f"{file_name}: not a csvplus-tpu index file")
+            if meta.get("version") != 2:
+                raise ValueError(
+                    f"{file_name}: unsupported columnar index version "
+                    f"{meta.get('version')}"
+                )
+            dev = default_device(None)
+            cols = {
+                name: StringColumn(
+                    z[f"d:{name}"], jax.device_put(z[f"c:{name}"], dev)
+                )
+                for name in meta["columns"]
+            }
+    except (KeyError, zipfile.BadZipFile, json.JSONDecodeError) as e:
+        raise ValueError(f"{file_name}: not a csvplus-tpu index file") from e
+    table = DeviceTable(cols, meta["count"], dev)
+    return Index(
+        IndexImpl(None, meta["key_columns"], dev=DeviceIndex.build(table, meta["key_columns"]))
+    )
 
 
 def _validate_index_columns(columns: Sequence[str]) -> Tuple[str, ...]:

@@ -149,3 +149,45 @@ def test_device_index_persistence_roundtrip(dev_people, tmp_path):
     di.write_to(path)
     back = load_index(path)
     assert Take(back).to_rows() == Take(di).to_rows()
+
+
+def test_columnar_persistence_roundtrip(dev_people, host_people, tmp_path):
+    """A device-lazy index persists columnar (v2) and loads back lazy,
+    with identical contents and working finds (SURVEY M5)."""
+    from csvplus_tpu import load_index
+
+    di = dev_people.index_on("surname", "name")
+    assert di._impl.is_lazy
+    path = str(tmp_path / "col.index")
+    di.write_to(path)
+    assert di._impl.is_lazy  # saving never materialized host rows
+    back = load_index(path)
+    assert back._impl.is_lazy and back.device_table.supported
+    assert Take(back).to_rows() == Take(di).to_rows()
+    assert back.find("Jones").to_rows() == di.find("Jones").to_rows()
+    # v1 JSONL still round-trips for host indexes
+    hi = host_people.index_on("id")
+    p1 = str(tmp_path / "host.index")
+    hi.write_to(p1)
+    from csvplus_tpu import Take as T
+
+    assert T(load_index(p1)).to_rows() == T(hi).to_rows()
+
+
+def test_load_index_rejects_foreign_zip(tmp_path):
+    """A PK-magic file that is not our npz raises the documented
+    ValueError (review regression)."""
+    import zipfile
+
+    from csvplus_tpu import load_index
+
+    p = tmp_path / "foreign.zip"
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("hello.txt", "not an index")
+    with pytest.raises(ValueError) as e:
+        load_index(str(p))
+    assert "not a csvplus-tpu index file" in str(e.value)
+    junk = tmp_path / "junk"
+    junk.write_text("garbage")
+    with pytest.raises(ValueError):
+        load_index(str(junk))
