@@ -420,12 +420,12 @@ def _load_columnar(file_name: str) -> Index:
                 )
                 for name in meta["columns"]
             }
+            count = meta["count"]
+            key_columns = meta["key_columns"]
     except (KeyError, zipfile.BadZipFile, json.JSONDecodeError) as e:
         raise ValueError(f"{file_name}: not a csvplus-tpu index file") from e
-    table = DeviceTable(cols, meta["count"], dev)
-    return Index(
-        IndexImpl(None, meta["key_columns"], dev=DeviceIndex.build(table, meta["key_columns"]))
-    )
+    table = DeviceTable(cols, count, dev)
+    return Index(IndexImpl(None, key_columns, dev=DeviceIndex.build(table, key_columns)))
 
 
 def _validate_index_columns(columns: Sequence[str]) -> Tuple[str, ...]:

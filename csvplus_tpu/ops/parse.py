@@ -1,13 +1,16 @@
 """Device-side CSV parsing: bytes as u8 tensors (SURVEY.md §7 hard part 1).
 
-TPUs have no string ops, but a CSV chunk is just a ``uint8[n]`` tensor:
+TPUs have no string ops, but a CSV chunk is just a ``uint8[n]`` tensor.
+Division of labor (revised after profiling; compile-cache churn matters
+more than moving every op to the device):
 
-* separators are vectorized compares (``data == ','``, ``data == '\\n'``);
-* field offsets fall out of one ``sum`` (host sync for the count — the
-  only data-dependent allocation) plus ``nonzero`` with a static size;
-* per-record field counts are differences of the delimiter prefix-sum
-  sampled at newline positions;
-* **dictionary encoding happens on device too**: fields (<= 8 bytes) are
+* separator scan + field offsets + per-record counts run in vectorized
+  numpy — those index vectors are consumed on the host immediately
+  (header policy, column slicing), so device-side computation would buy
+  a round-trip plus a per-file-size XLA compile and nothing else;
+* the byte buffer uploads once (pow2-bucketed so downstream kernels
+  compile a bounded executable set) and **dictionary encoding — the
+  heavy part — happens on device**: fields (<= 8 bytes) are
   gathered into NUL-padded byte matrices and packed big-endian into two
   int32 lanes (sign-flipped so signed compare == byte order), a two-key
   stable ``lax.sort`` groups equal fields, run boundaries become dense
@@ -38,63 +41,59 @@ _QUOTE = 34
 _SIGN = np.int32(-0x80000000)  # sign-flip bias: signed order == byte order
 
 
-@jax.jit
-def _scan_features(data: jax.Array, delim: jax.Array):
-    """One fused pass over the byte tensor: eligibility + separator masks."""
-    nl = data == _NL
-    dl = data == delim
-    sep = nl | dl
-    n_sep = jnp.sum(sep)
-    n_nl = jnp.sum(nl)
-    return sep, nl, dl, n_sep, n_nl
+def _offsets_np(host_arr: np.ndarray, delim_byte: int, trailing_nl: bool):
+    """Field starts/ends and per-record field counts, in numpy.
 
-
-@partial(jax.jit, static_argnames=("n_sep", "n_nl", "trailing_nl"))
-def _offsets_kernel(sep, nl, dl, n_sep: int, n_nl: int, trailing_nl: bool):
-    """Field starts/ends and per-record field counts, statically sized."""
-    n = sep.shape[0]
-    sep_pos = jnp.nonzero(sep, size=n_sep)[0]
-    nl_pos = jnp.nonzero(nl, size=n_nl)[0]
+    The offset vectors are consumed on the host (column slicing + header
+    policy) immediately, so computing them device-side would only add a
+    round-trip — and a per-file-size compile.  The numpy version is
+    C-speed, shape-churn-free, and identical in output.
+    """
+    n = host_arr.shape[0]
+    nl_mask = host_arr == _NL
+    dl_mask = host_arr == delim_byte
+    sep_pos = np.flatnonzero(nl_mask | dl_mask)
+    nl_pos = np.flatnonzero(nl_mask)
+    n_sep = sep_pos.shape[0]
 
     n_fields = n_sep + (0 if trailing_nl else 1)
-    starts = jnp.zeros(n_fields, dtype=jnp.int32)
-    starts = starts.at[1:].set((sep_pos + 1)[: n_fields - 1].astype(jnp.int32))
-    ends = jnp.concatenate(
-        [sep_pos.astype(jnp.int32), jnp.full(1, n, jnp.int32)]
-    )[:n_fields]
+    starts = np.zeros(n_fields, dtype=np.int64)
+    starts[1:] = (sep_pos + 1)[: n_fields - 1]
+    ends = np.append(sep_pos, n)[:n_fields]
 
     # fields per record: delimiters before each newline, differenced
-    dl_cum = jnp.cumsum(dl)
+    dl_cum = np.cumsum(dl_mask)
     dl_at_nl = dl_cum[nl_pos]
-    prev = jnp.concatenate([jnp.zeros(1, dl_at_nl.dtype), dl_at_nl[:-1]])
-    rec_counts = (dl_at_nl - prev + 1).astype(jnp.int32)
+    rec_counts = np.diff(dl_at_nl, prepend=0) + 1
     if not trailing_nl:
-        total_dl = dl_cum[-1] if n else jnp.int32(0)
-        last = (total_dl - (dl_at_nl[-1] if n_nl else 0) + 1).astype(jnp.int32)
-        rec_counts = jnp.concatenate([rec_counts, last[None]])
-    return starts, ends, rec_counts
+        total_dl = int(dl_cum[-1]) if n else 0
+        last = total_dl - (int(dl_at_nl[-1]) if nl_pos.size else 0) + 1
+        rec_counts = np.append(rec_counts, last)
+    return starts, ends, rec_counts.astype(np.int32)
 
 
-@partial(jax.jit, static_argnames=("width",))
-def _encode_column_kernel(data, starts, lens, width: int):
-    """Device dictionary-encode one column of fields (width <= 8 bytes).
+@jax.jit
+def _encode_column_kernel(data, starts, lens):
+    """Device dictionary-encode one column of fields (<= 8 bytes each).
 
-    Returns (codes in row order, number of uniques, sorted unique hi/lo
-    packs, first-row-index of each unique) — the host decodes only the
-    uniques into the string dictionary.
+    Width is fixed at 8 (shorter fields are masked by ``lens``) and the
+    caller buckets the row count, so the jit cache stays tiny.
+    Returns (codes in row order, number of uniques, first-row-index of
+    each unique) — the host decodes only the uniques into the string
+    dictionary.
     """
+    width = 8
     m = starts.shape[0]
     idx = starts[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
     mask = jnp.arange(width, dtype=jnp.int32)[None, :] < lens[:, None]
     safe = jnp.clip(idx, 0, data.shape[0] - 1)
     mat = jnp.where(mask, jnp.take(data, safe, axis=0), 0).astype(jnp.int32)
 
-    hw = min(4, width)
     hi = jnp.zeros(m, dtype=jnp.int32)
-    for b in range(hw):
+    for b in range(4):
         hi = hi | (mat[:, b] << (8 * (3 - b)))
     lo = jnp.zeros(m, dtype=jnp.int32)
-    for b in range(4, width):
+    for b in range(4, 8):
         lo = lo | (mat[:, b] << (8 * (7 - b)))
     hi = hi ^ _SIGN  # signed compare now equals byte-lexicographic order
     lo = lo ^ _SIGN
@@ -138,15 +137,23 @@ def parse_simple_csv_device(
         or data.startswith(b"\n")
     ):
         return None
-    arr = jax.device_put(np.frombuffer(data, dtype=np.uint8), device)
-    sep, nl, dl, n_sep, n_nl = _scan_features(arr, jnp.uint8(ord(delimiter)))
+    # pow2-bucket the upload so downstream kernels compile a bounded set
+    # of executables; NUL padding lies beyond real_n and is never a
+    # separator (eligibility already rejected NULs inside the data)
+    real_n = len(data)
+    padded = max(1 << (real_n - 1).bit_length(), 2048)
+    host_arr = np.frombuffer(data, dtype=np.uint8)
+    if padded != real_n:
+        host_arr = np.concatenate(
+            [host_arr, np.zeros(padded - real_n, dtype=np.uint8)]
+        )
+    arr = jax.device_put(host_arr, device)
     trailing_nl = data.endswith(b"\n")
-    starts, ends, rec_counts = _offsets_kernel(
-        sep, nl, dl, int(n_sep), int(n_nl), trailing_nl
+    starts, ends, rec_counts = _offsets_np(
+        host_arr[:real_n], ord(delimiter), trailing_nl
     )
-    starts_np = np.asarray(starts, dtype=np.int64)
-    lens_np = (np.asarray(ends) - starts_np).astype(np.int32)
-    return starts_np, lens_np, np.asarray(rec_counts), arr
+    lens_np = (ends - starts).astype(np.int32)
+    return starts, lens_np, rec_counts, arr
 
 
 _DEVICE_ENCODE_MAX_LEN = 8
@@ -169,12 +176,20 @@ def encode_column_device(
     if width > _DEVICE_ENCODE_MAX_LEN:
         return None
     width = max(width, 1)
+    # bucket the row count (pow2, floor 2048) so the jitted kernel
+    # compiles O(log n) executables total; pad entries duplicate field 0,
+    # which cannot change the dictionary or the real rows' codes
+    m = starts.shape[0]
+    m_pad = max(1 << (m - 1).bit_length() if m > 1 else 1, 2048)
+    if m_pad != m:
+        starts = np.concatenate([starts, np.full(m_pad - m, starts[0])])
+        lens = np.concatenate([lens, np.full(m_pad - m, lens[0], dtype=lens.dtype)])
     codes, n_uniq, uniq_first = _encode_column_kernel(
         data_dev,
         jnp.asarray(starts, dtype=jnp.int32),
         jnp.asarray(lens, dtype=jnp.int32),
-        width,
     )
+    codes = codes[:m]
     k = int(n_uniq)
     rows = np.asarray(uniq_first)[:k]
     # host touches only the unique values to build the dictionary

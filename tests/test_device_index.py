@@ -169,9 +169,7 @@ def test_columnar_persistence_roundtrip(dev_people, host_people, tmp_path):
     hi = host_people.index_on("id")
     p1 = str(tmp_path / "host.index")
     hi.write_to(p1)
-    from csvplus_tpu import Take as T
-
-    assert T(load_index(p1)).to_rows() == T(hi).to_rows()
+    assert Take(load_index(p1)).to_rows() == Take(hi).to_rows()
 
 
 def test_load_index_rejects_foreign_zip(tmp_path):
