@@ -291,9 +291,11 @@ def _column_positions(data_counts, field_offset, header, rec_base, pad_allowed):
 
 
 def read_device_parsed_columns(reader, path: str):
-    """FULLY device-side ingest tier: byte scan, field offsets, and
-    dictionary encoding all run as JAX kernels (ops/parse.py); the host
-    only resolves the header and decodes unique dictionary values.
+    """Device-encode ingest tier (ops/parse.py): separator scan and
+    field offsets run in vectorized numpy (the host consumes them
+    immediately), the bytes upload once, and the heavy dictionary
+    encoding runs as a JAX sort-rank kernel on device; the host touches
+    only header fields and unique dictionary values.
 
     Simple rectangular CSV only (no quotes/CR/comments/blank lines);
     returns (names, {name: (dictionary, codes)}) or None to fall back.

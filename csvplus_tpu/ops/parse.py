@@ -27,8 +27,7 @@ behavioral spec.  Differential tests pin equality against the Reader.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Dict, List, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -137,11 +136,13 @@ def parse_simple_csv_device(
         or data.startswith(b"\n")
     ):
         return None
-    # pow2-bucket the upload so downstream kernels compile a bounded set
+    # bucket the upload size so downstream kernels compile a bounded set
     # of executables; NUL padding lies beyond real_n and is never a
-    # separator (eligibility already rejected NULs inside the data)
+    # separator (eligibility already rejected NULs inside the data).
+    # Pow2 up to 64MB, then 1.25x geometric steps so a large file never
+    # pads to ~2x its size
     real_n = len(data)
-    padded = max(1 << (real_n - 1).bit_length(), 2048)
+    padded = _bucket_len(real_n)
     host_arr = np.frombuffer(data, dtype=np.uint8)
     if padded != real_n:
         host_arr = np.concatenate(
@@ -154,6 +155,22 @@ def parse_simple_csv_device(
     )
     lens_np = (ends - starts).astype(np.int32)
     return starts, lens_np, rec_counts, arr
+
+
+_BUCKET_POW2_CAP = 64 << 20
+
+
+def _bucket_len(n: int) -> int:
+    """Upload-size bucket: pow2 below 64MB, then 1.25x geometric steps
+    (bounded jit cache either way, bounded padding waste above)."""
+    if n <= 2048:
+        return 2048
+    if n <= _BUCKET_POW2_CAP:
+        return 1 << (n - 1).bit_length()
+    b = _BUCKET_POW2_CAP
+    while b < n:
+        b = int(b * 1.25)
+    return b
 
 
 _DEVICE_ENCODE_MAX_LEN = 8
@@ -172,10 +189,8 @@ def encode_column_device(
     """
     if starts.shape[0] == 0:
         return np.empty(0, dtype="S1"), np.empty(0, dtype=np.int32)
-    width = int(lens.max())
-    if width > _DEVICE_ENCODE_MAX_LEN:
+    if int(lens.max()) > _DEVICE_ENCODE_MAX_LEN:
         return None
-    width = max(width, 1)
     # bucket the row count (pow2, floor 2048) so the jitted kernel
     # compiles O(log n) executables total; pad entries duplicate field 0,
     # which cannot change the dictionary or the real rows' codes
