@@ -177,6 +177,7 @@ def main() -> None:
     data = _gen_data(n_orders, n_cust, n_prod)
     device_rps = _bench_device(data, reps)
     host_rps = _bench_host(data, min(sample, n_orders))
+    _secondary_metrics(n_orders)
 
     print(
         json.dumps(
@@ -188,6 +189,55 @@ def main() -> None:
             }
         )
     )
+
+
+def _secondary_metrics(n_orders: int) -> None:
+    """Informational numbers for the other BASELINE configs, to stderr
+    (the driver contract is ONE json line on stdout)."""
+    try:
+        import tempfile
+
+        import numpy as np
+
+        from csvplus_tpu import from_file
+        rng = np.random.default_rng(7)
+        n = min(n_orders, 1_000_000)
+        with tempfile.TemporaryDirectory() as td:
+            path = f"{td}/orders.csv"
+            with open(path, "w") as f:
+                f.write("order_id,cust_id,qty\n")
+                ids = rng.integers(0, 100_000, n)
+                f.write(
+                    "".join(
+                        f"{i},c{int(c)},{int(q)}\n"
+                        for i, (c, q) in enumerate(
+                            zip(ids, rng.integers(1, 101, n))
+                        )
+                    )
+                )
+            t0 = time.perf_counter()
+            src = from_file(path).on_device()
+            # sync the ingested code arrays (async dispatch would stop the
+            # clock before upload/encode completes) without materializing
+            # a redundant copy of the table
+            for col in src.plan.table.columns.values():
+                col.codes.block_until_ready()
+            t_ingest = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            idx = src.index_on("cust_id")
+            _ = len(idx)
+            t_index = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            idx.resolve_duplicates("first")
+            _ = len(idx)
+            t_dedup = time.perf_counter() - t0
+        sys.stderr.write(
+            f"bench[secondary]: ingest {n / t_ingest:,.0f} rows/s | "
+            f"index build {n / t_index:,.0f} rows/s | "
+            f"policy dedup {n / t_dedup:,.0f} rows/s (n={n})\n"
+        )
+    except Exception as e:  # secondary metrics must never break the line
+        sys.stderr.write(f"bench[secondary] skipped: {e}\n")
 
 
 if __name__ == "__main__":

@@ -189,3 +189,33 @@ def test_load_index_rejects_foreign_zip(tmp_path):
     junk.write_text("garbage")
     with pytest.raises(ValueError):
         load_index(str(junk))
+
+
+def test_wide_tier_point_bounds_find():
+    """Find/SubIndex on a >31-bit packed (host-int64 tier) device index
+    decode only the matching range and agree with the host."""
+    import random
+
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    rng = random.Random(4)
+    n = 70_000
+    a = [f"a{i:06d}" for i in range(n)]
+    b = [f"b{rng.randrange(n):06d}" for _ in range(n)]
+    rows_host = [Row({"a": x, "b": y}) for x, y in zip(a, b)]
+    host_idx = TakeRows(rows_host).index_on("a", "b")
+    dev_idx = source_from_table(
+        DeviceTable.from_pylists({"a": a, "b": b}, device="cpu")
+    ).index_on("a", "b")
+    assert dev_idx.device_table.packed_i64 is not None  # wide tier
+    assert dev_idx._impl.is_lazy
+    probe = a[123]
+    assert dev_idx.find(probe).to_rows() == host_idx.find(probe).to_rows()
+    assert (
+        dev_idx.find(probe, b[123]).to_rows()
+        == host_idx.find(probe, b[123]).to_rows()
+    )
+    assert dev_idx._impl.is_lazy  # prefix finds never materialized
+    sub = dev_idx.sub_index(probe)
+    assert Take(sub).to_rows() == Take(host_idx.sub_index(probe)).to_rows()

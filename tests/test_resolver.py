@@ -25,8 +25,11 @@ def people_rows(people_csv):
 
 
 def test_resolver_randomized(people_rows):
+    import os
+
+    iters = int(os.environ.get("CSVPLUS_HYPOTHESIS_EXAMPLES") or 200)
     rng = random.Random(7)
-    for _ in range(200):  # reference runs 1000; 200 keeps the suite fast
+    for _ in range(iters):  # reference runs 1000; soak knob scales us up
         src = list(people_rows)
         dup = src[rng.randrange(len(src))]
         n = rng.randrange(100) + 1
