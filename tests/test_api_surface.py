@@ -110,3 +110,37 @@ def test_predicates_accept_plain_dicts_and_rows():
 def test_validate_passthrough_alias(people_csv):
     out = Take(csvplus.FromFile(people_csv)).Validate(lambda r: None).ToRows()
     assert len(out) == 120
+
+
+def test_concurrent_pull_iteration(people_csv):
+    """Two pythonic iterations of the same source can interleave without
+    interference (each __iter__ spawns its own producer)."""
+    import itertools
+
+    src = Take(csvplus.FromFile(people_csv))
+    a, b = iter(src), iter(src)
+    rows_a, rows_b = [], []
+    for ra, rb in itertools.zip_longest(a, b):
+        rows_a.append(ra)
+        rows_b.append(rb)
+    assert rows_a == rows_b and len(rows_a) == 120
+
+
+def test_pull_iteration_propagates_errors():
+    src = Take(csvplus.from_reader("a,b\n1\n"))
+    with pytest.raises(DataSourceError) as e:
+        list(src)
+    assert "wrong number of fields" in str(e.value)
+
+
+def test_stream_backed_on_device():
+    """OnDevice works for non-file readers (no native path) and for
+    in-memory rows, via the Python ingest fallback."""
+    import io as _io
+
+    rows = Take(
+        csvplus.from_reader(_io.StringIO("a,b\nx,1\ny,2\n"))
+    ).to_rows()
+    dev = csvplus.from_reader("a,b\nx,1\ny,2\n").on_device("cpu")
+    assert dev.plan is not None
+    assert dev.to_rows() == rows
