@@ -134,12 +134,11 @@ def test_pull_iteration_propagates_errors():
 
 
 def test_stream_backed_on_device():
-    """OnDevice works for non-file readers (no native path) and for
-    in-memory rows, via the Python ingest fallback."""
-    import io as _io
-
+    """OnDevice works for non-file readers (no native scanner path),
+    via the Python ingest fallback.  (The in-memory-rows
+    DataSource.on_device path is pinned in test_device.py.)"""
     rows = Take(
-        csvplus.from_reader(_io.StringIO("a,b\nx,1\ny,2\n"))
+        csvplus.from_reader(io.StringIO("a,b\nx,1\ny,2\n"))
     ).to_rows()
     dev = csvplus.from_reader("a,b\nx,1\ny,2\n").on_device("cpu")
     assert dev.plan is not None
