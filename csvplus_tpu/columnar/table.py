@@ -62,6 +62,11 @@ def encode_strings(values: Sequence[str]) -> "tuple[np.ndarray, np.ndarray]":
     Python string objects on the ingest path.  ``None`` entries (absent
     cells) encode as code -1 and do not enter the dictionary.
     """
+    if isinstance(values, np.ndarray) and values.dtype.kind in ("U", "S"):
+        # numpy string arrays cannot hold None: skip the per-element scan
+        arr_b = values if values.dtype.kind == "S" else np.char.encode(values, "utf-8")
+        dictionary, codes = np.unique(arr_b, return_inverse=True)
+        return dictionary, codes.astype(np.int32)
     arr = np.asarray(values, dtype=object)
     present = np.array([v is not None for v in arr], dtype=bool)
     if present.all():
