@@ -308,7 +308,6 @@ class Reader:
     TrimLeadingSpace = trim_leading_space
     AssumeHeader = assume_header
     ExpectHeader = expect_header
-    SelectColumnsReader = select_columns
     SelectColumns = select_columns
     NumFields = num_fields
     NumFieldsAuto = num_fields_auto
