@@ -550,3 +550,42 @@ def test_explain_note_propagates_and_covers_all_breaks(dev_people, people_csv):
     assert "no device copy" in j.explain()
     v = dev_people.validate(lambda r: None)
     assert "no symbolic form" in v.explain()
+
+
+def test_profile_to_writes_trace(tmp_path, dev_people):
+    """profile_to captures a JAX device trace directory."""
+    import os
+
+    from csvplus_tpu import profile_to
+
+    log_dir = str(tmp_path / "trace")
+    with profile_to(log_dir):
+        dev_people.filter(Like({"name": "Ava"})).to_rows()
+    assert os.path.isdir(log_dir) and os.listdir(log_dir)
+
+
+def test_take_of_device_table_escape_hatch(dev_people, host_people):
+    """take(DeviceTable) streams decoded rows (the documented escape
+    hatch) and carries a plan for symbolic continuation."""
+    from csvplus_tpu import take
+    from csvplus_tpu.columnar.exec import execute_plan
+
+    table = execute_plan(dev_people.plan)
+    src = take(table)
+    assert src.plan is not None
+    assert src.to_rows() == host_people.to_rows()
+    # push-style over the table directly
+    seen = []
+    table.iterate(seen.append)
+    assert len(seen) == 120
+
+
+def test_sharded_table_from_pylists():
+    from csvplus_tpu.parallel.mesh import make_mesh
+    from csvplus_tpu.parallel.sharded import ShardedTable
+
+    st = ShardedTable.from_pylists(
+        {"a": [str(i) for i in range(11)]}, make_mesh(8)
+    )
+    assert st.nrows == 11 and st.padded % 8 == 0
+    assert [r["a"] for r in st.to_rows()] == [str(i) for i in range(11)]
