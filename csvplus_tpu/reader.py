@@ -122,6 +122,9 @@ class Reader:
     # -- iteration (csvplus.go:1078-1146) ----------------------------------
 
     def iterate(self, fn: RowFunc) -> None:
+        """Read the input record by record, convert each to a Row per the
+        configured header policy, and call *fn* (csvplus.go:1078-1146).
+        Errors carry 1-based record numbers."""
         stream, closer = self._open(line_no=1)
         try:
             records, header, line_no, expected_fields = self._start(stream)

@@ -36,7 +36,10 @@ from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
 from .errors import DataSourceError, StopPipeline
 from .row import Row, merge_rows
 
-RowFunc = Callable[[Row], None]  # raises to stop/fail (Go: func(Row) error)
+#: A row callback: called once per row; raise :class:`StopPipeline` to
+#: stop cleanly, any other exception to fail (Go: ``func(Row) error``,
+#: csvplus.go:208).
+RowFunc = Callable[[Row], None]
 
 
 def iterate(rows: Sequence[Row], fn: RowFunc, clone: bool = True) -> None:
@@ -385,26 +388,35 @@ class DataSource:
     # -- sinks (implemented in sinks.py) -----------------------------------
 
     def to_csv(self, out, *columns: str) -> None:
+        """Drive the chain, writing selected columns as canonical CSV to
+        *out* (csvplus.go:379-406; see :func:`csvplus_tpu.sinks.to_csv`)."""
         from .sinks import to_csv
 
         to_csv(self, out, *columns)
 
     def to_csv_file(self, name: str, *columns: str) -> None:
+        """CSV sink to a named file; the file is removed on any error
+        (csvplus.go:411-443)."""
         from .sinks import to_csv_file
 
         to_csv_file(self, name, *columns)
 
     def to_json(self, out) -> None:
+        """Drive the chain, writing a JSON array of row objects to *out*
+        (csvplus.go:446-475, byte-compatible with Go's json.Encoder)."""
         from .sinks import to_json
 
         to_json(self, out)
 
     def to_json_file(self, name: str) -> None:
+        """JSON sink to a named file; the file is removed on any error
+        (csvplus.go:478-480)."""
         from .sinks import to_json_file
 
         to_json_file(self, name)
 
     def to_rows(self) -> List[Row]:
+        """Drive the chain and collect every row (csvplus.go:483-490)."""
         from .sinks import to_rows
 
         return to_rows(self)
