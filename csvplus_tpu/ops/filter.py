@@ -27,6 +27,65 @@ class UnsupportedPredicate(Exception):
     """Raised when a predicate cannot be lowered (opaque Python callable)."""
 
 
+def _group_by_column(terms):
+    """Merge (codes, target) terms that reference the same column into
+    (codes, [targets...]) so a k-value IN-list streams its column once."""
+    grouped = {}
+    order = []
+    for codes, code in terms:
+        key = id(codes)
+        if key not in grouped:
+            grouped[key] = (codes, [])
+            order.append(key)
+        grouped[key][1].append(code)
+    return [grouped[k] for k in order]
+
+
+def _mask_from_terms(terms, nrows: int, mode: str):
+    """Fused (Pallas) or jnp mask over equality terms.
+
+    *terms* is a list of (codes, target) or (codes, [targets...]); in
+    "all" mode every entry must be a single target (a conjunction of two
+    different targets on one column is constant-false and never built).
+    """
+    if len(terms) >= 2:
+        from .pallas_mask import fused_equality_mask
+
+        fused = fused_equality_mask(
+            [t[0] for t in terms], [t[1] for t in terms], nrows, mode=mode
+        )
+        if fused is not None:
+            return fused
+    mask = None
+    for codes, target in terms:
+        targets = target if isinstance(target, (list, tuple)) else [target]
+        m = None
+        for t in targets:
+            e = codes == t
+            m = e if m is None else (m | e)
+        mask = m if mask is None else (mask & m if mode == "all" else mask | m)
+    return mask
+
+
+def _equality_terms(cols, preds):
+    """Flatten predicates into (codes, target) equality terms when every
+    one is a single-column Like; terms on missing columns/values drop out
+    (they are constant-false in a disjunction).  None = not flattenable."""
+    terms = []
+    for p in preds:
+        if not isinstance(p, Like) or len(p.match) != 1:
+            return None
+        (col, val), = p.match.items()
+        if col not in cols:
+            continue
+        c = cols[col]
+        code = lookup_code(c.dictionary, val)
+        if code < 0:
+            continue
+        terms.append((c.codes, code))
+    return terms
+
+
 def build_mask(cols: Dict[str, StringColumn], nrows: int, pred) -> jnp.ndarray:
     """Lower *pred* to a device boolean mask over all *nrows* rows."""
     if isinstance(pred, Like):
@@ -40,27 +99,21 @@ def build_mask(cols: Dict[str, StringColumn], nrows: int, pred) -> jnp.ndarray:
                 return jnp.zeros(nrows, dtype=bool)
             terms.append((c.codes, code))
         assert terms  # Like() rejects empty match rows
-        if len(terms) >= 2:
-            # multi-column conjunction: one fused VMEM pass (Pallas),
-            # reading each row once instead of k intermediate masks
-            from .pallas_mask import fused_equality_mask
-
-            fused = fused_equality_mask(
-                [t[0] for t in terms], [t[1] for t in terms], nrows, mode="all"
-            )
-            if fused is not None:
-                return fused
-        mask = None
-        for codes, code in terms:
-            m = codes == code
-            mask = m if mask is None else (mask & m)
-        return mask
+        return _mask_from_terms(terms, nrows, mode="all")
     if isinstance(pred, All):
         mask = jnp.ones(nrows, dtype=bool)
         for p in pred.preds:
             mask = mask & build_mask(cols, nrows, p)
         return mask
     if isinstance(pred, Any_):
+        # disjunction of plain equality terms: one fused VPU pass, with
+        # IN-list terms on the same column grouped so each column
+        # streams once
+        terms = _equality_terms(cols, pred.preds)
+        if terms is not None:
+            if not terms:  # every branch referenced a missing column/value
+                return jnp.zeros(nrows, dtype=bool)
+            return _mask_from_terms(_group_by_column(terms), nrows, mode="any")
         mask = jnp.zeros(nrows, dtype=bool)
         for p in pred.preds:
             mask = mask | build_mask(cols, nrows, p)

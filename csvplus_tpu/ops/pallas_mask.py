@@ -39,7 +39,10 @@ def _use_interpret() -> bool:
     jax.jit, static_argnames=("mode", "targets", "interpret")
 )
 def _fused_mask_call(
-    mode: str, targets: Tuple[int, ...], interpret: bool, *codes: jax.Array
+    mode: str,
+    targets: "Tuple[Tuple[int, ...], ...]",
+    interpret: bool,
+    *codes: jax.Array,
 ) -> jax.Array:
     from jax.experimental import pallas as pl
 
@@ -50,8 +53,12 @@ def _fused_mask_call(
     def kernel(*refs):
         in_refs, out_ref = refs[:-1], refs[-1]
         acc = None
-        for j, t in enumerate(targets):
-            eq = in_refs[j][:] == jnp.int32(t)
+        for j, col_targets in enumerate(targets):
+            tile = in_refs[j][:]  # each column streams exactly once
+            eq = None
+            for t in col_targets:  # IN-list membership per column
+                e = tile == jnp.int32(t)
+                eq = e if eq is None else (eq | e)
             acc = eq if acc is None else (acc & eq if mode == "all" else acc | eq)
         out_ref[:] = acc
 
@@ -69,18 +76,26 @@ def _fused_mask_call(
 
 def fused_equality_mask(
     code_arrays: Sequence[jax.Array],
-    target_codes: Sequence[int],
+    target_codes: "Sequence[int] | Sequence[Sequence[int]]",
     nrows: int,
     mode: str = "all",
 ) -> "jax.Array | None":
-    """Fused mask over up to MAX_COLS (column == target) terms.
+    """Fused mask over up to MAX_COLS distinct columns.
 
-    Returns a bool[nrows] device array, or None when the predicate shape
-    doesn't fit this kernel (caller uses the jnp path).
+    Each entry of *target_codes* is one target (or, in "any" mode, a
+    LIST of targets — IN-list membership) for the matching code array;
+    every column streams through VMEM exactly once regardless of how
+    many values it is compared against.  Returns a bool[nrows] device
+    array, or None when the predicate shape doesn't fit this kernel
+    (caller uses the jnp path).
     """
     k = len(code_arrays)
     if k == 0 or k > MAX_COLS or nrows == 0:
         return None
+    norm = tuple(
+        tuple(int(x) for x in t) if isinstance(t, (list, tuple)) else (int(t),)
+        for t in target_codes
+    )
     pad = (-nrows) % _TILE
     cols = []
     for c in code_arrays:
@@ -90,9 +105,7 @@ def fused_equality_mask(
             c = jnp.concatenate([c, jnp.full(pad, -2, dtype=jnp.int32)])
         cols.append(c)
     try:
-        mask = _fused_mask_call(
-            mode, tuple(int(t) for t in target_codes), _use_interpret(), *cols
-        )
+        mask = _fused_mask_call(mode, norm, _use_interpret(), *cols)
     except Exception:  # pallas unavailable for this backend/shape
         return None
     return mask[:nrows]

@@ -48,3 +48,40 @@ def test_multi_column_like_uses_fused_path(people_csv):
     assert dev.filter(p).to_rows() == host.filter(p).to_rows()
     q = Like({"name": "Amelia", "surname": "NoSuch"})
     assert dev.filter(q).to_rows() == host.filter(q).to_rows() == []
+
+
+def test_any_of_likes_fused_parity(people_csv):
+    """Any(Like, Like, ...) of single-column equalities fuses to one
+    'any' kernel and matches the host, including missing columns/values."""
+    from csvplus_tpu import Any, Take, from_file
+
+    dev = from_file(people_csv).on_device("cpu")
+    host = Take(from_file(people_csv))
+    for pred in [
+        Any(Like({"surname": "Jones"}), Like({"surname": "Lewis"}), Like({"name": "Ava"})),
+        Any(Like({"surname": "Jones"}), Like({"nope": "x"})),
+        Any(Like({"nope": "x"}), Like({"name": "NoSuchValue"})),
+        Any(Like({"name": "Amelia", "surname": "Smith"}), Like({"name": "Jack"})),  # multi-col branch: recursive path
+    ]:
+        assert dev.filter(pred).to_rows() == host.filter(pred).to_rows()
+
+
+def test_in_list_grouping_streams_column_once(people_csv):
+    """A 12-value IN-list on one column groups into a single streamed
+    column (fusion survives beyond MAX_COLS terms) and stays correct."""
+    from csvplus_tpu import Any, Take, from_file
+    from conftest import PEOPLE_SURNAMES
+
+    dev = from_file(people_csv).on_device("cpu")
+    host = Take(from_file(people_csv))
+    pred = Any(*[Like({"surname": s}) for s in PEOPLE_SURNAMES])  # 12 terms
+    got = dev.filter(pred).to_rows()
+    assert got == host.filter(pred).to_rows()
+    assert len(got) == 120  # every surname matches
+    mixed = Any(
+        Like({"surname": "Jones"}),
+        Like({"surname": "Lewis"}),
+        Like({"name": "Ava"}),
+        Like({"surname": "Jones"}),  # duplicate value, same column
+    )
+    assert dev.filter(mixed).to_rows() == host.filter(mixed).to_rows()
