@@ -157,3 +157,17 @@ def test_random_dedup_policies_match(rows):
         d = source_from_table(DeviceTable.from_rows(rows, device="cpu")).index_on("a")
         d.resolve_duplicates(policy)
         assert Take(d).to_rows() == Take(h).to_rows()
+
+
+@given(tables(min_rows=0, max_rows=24), st.lists(stages(), min_size=0, max_size=3))
+def test_random_pipeline_sharded_matches_host(rows, pipeline):
+    """Random symbolic pipelines over a mesh-sharded table == host."""
+    from csvplus_tpu.parallel.mesh import make_mesh
+
+    host = run_either(take_rows(rows), pipeline)
+    table = DeviceTable.from_rows(rows, device="cpu").with_sharding(make_mesh(8))
+    dev = run_either(source_from_table(table), pipeline)
+    if host[0] == "rows":
+        assert dev == host
+    else:
+        assert dev[0] == "error"
