@@ -59,12 +59,16 @@ class IndexImpl:
     csvplus.go:785-788).  ``rows`` may be lazily backed by a sorted
     device table (``dev``), decoded on first host access."""
 
-    __slots__ = ("_rows", "columns", "_keys", "dev")
+    __slots__ = ("_rows", "columns", "_keys", "_probe_map", "dev")
 
     def __init__(self, rows: Optional[List[Row]], columns: Sequence[str], dev=None):
         self._rows = rows
         self.columns = list(columns)
         self._keys: Optional[List[Tuple[str, ...]]] = None
+        # full-width key tuple -> (lower, upper); built lazily for the
+        # host join's per-row probes (hash beats bisect like the Go
+        # baseline's map would); prefix probes still bisect
+        self._probe_map: "Optional[dict]" = None
         self.dev = dev  # ops.join.DeviceIndex over the sorted columnar copy
 
     # -- lazy materialization ---------------------------------------------
@@ -102,6 +106,7 @@ class IndexImpl:
 
     def _invalidate(self) -> None:
         self._keys = None
+        self._probe_map = None
 
     def sort(self) -> None:
         """Sort rows by the key columns (csvplus.go:794-807).  Stable —
@@ -126,6 +131,20 @@ class IndexImpl:
             return 0, len(self.rows)
         k = len(values)
         v = tuple(values)
+        if k == len(self.columns):
+            pm = self._probe_map
+            if pm is None:
+                pm = {}
+                keys = self.keys
+                i, n = 0, len(keys)
+                while i < n:
+                    j = i + 1
+                    while j < n and keys[j] == keys[i]:
+                        j += 1
+                    pm[keys[i]] = (i, j)
+                    i = j
+                self._probe_map = pm
+            return pm.get(v, (0, 0))
         keys = self.keys
         lower = bisect.bisect_left(keys, v, key=lambda kt: kt[:k])
         upper = bisect.bisect_right(keys, v, lo=lower, key=lambda kt: kt[:k])
