@@ -442,3 +442,36 @@ def test_flagship_padded_sharded_stream(people_csv, stock_csv, mesh):
     orders_t = DeviceTable.from_rows(orders_rows, device="cpu").with_sharding(mesh)
     tw = ThreewayJoin.build(orders_t, cust.device_table, prod.device_table)
     assert tw.run().to_rows() == host and len(host) == 6
+
+
+def test_partitioned_executor_join_randomized(monkeypatch, mesh):
+    """Seeded random sweep: sharded streams x non-unique indexes through
+    the partitioned all_to_all executor path == host, 25 shapes."""
+    import random
+
+    import csvplus_tpu.ops.join as J
+    from csvplus_tpu import Row, Take, TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    monkeypatch.setattr(J.DeviceIndex, "PARTITION_MIN_KEYS", 1)
+    rng = random.Random(13)
+    # fixed shape grid (SPMD kernels compile per shape; content random)
+    shapes = [(8, 0), (8, 16), (40, 16), (40, 64), (8, 64)] * 5
+    for trial, (n_idx, n_stream) in enumerate(shapes):
+        vocab = [f"k{v}" for v in range(rng.randint(1, 20))]
+        idx_rows = [
+            Row({"k": rng.choice(vocab), "v": str(i)}) for i in range(n_idx)
+        ]
+        stream_rows = [
+            Row({"k": rng.choice(vocab + ["miss1", "miss2"]), "s": str(i)})
+            for i in range(n_stream)
+        ]
+        idx = TakeRows(idx_rows).index_on("k")
+        host = TakeRows(stream_rows).join(idx, "k").to_rows()
+        idx.on_device("cpu")
+        table = DeviceTable.from_rows(stream_rows, device="cpu")
+        if table.nrows:
+            table = table.with_sharding(mesh)
+        dev = source_from_table(table).join(idx, "k").to_rows()
+        assert dev == host, f"trial {trial}: {len(dev)} vs {len(host)}"
