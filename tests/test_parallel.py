@@ -457,7 +457,7 @@ def test_partitioned_executor_join_randomized(monkeypatch, mesh):
     monkeypatch.setattr(J.DeviceIndex, "PARTITION_MIN_KEYS", 1)
     rng = random.Random(13)
     # fixed shape grid (SPMD kernels compile per shape; content random)
-    shapes = [(8, 0), (8, 16), (40, 16), (40, 64), (8, 64)] * 5
+    shapes = [(8, 0), (8, 16), (40, 16), (40, 64), (8, 64)] * 2
     for trial, (n_idx, n_stream) in enumerate(shapes):
         vocab = [f"k{v}" for v in range(rng.randint(1, 20))]
         idx_rows = [
