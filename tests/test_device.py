@@ -589,3 +589,17 @@ def test_sharded_table_from_pylists():
     )
     assert st.nrows == 11 and st.padded % 8 == 0
     assert [r["a"] for r in st.to_rows()] == [str(i) for i in range(11)]
+
+
+def test_transform_and_update_symbolic_parity(host_people, dev_people):
+    """Symbolic Transform and chained Update exprs lower on device."""
+    from csvplus_tpu import Update
+
+    u = Update(Rename({"born": "year"}), SetValue("tag", "T"))
+    assert dev_people.transform(u).plan is not None
+    same(dev_people.transform(u).to_rows(), host_people.transform(u).to_rows())
+    same(dev_people.map(u).to_rows(), host_people.map(u).to_rows())
+    # Update containing an opaque fn breaks the plan but not behavior
+    mixed = Update(SetValue("a", "1"), lambda r: r)
+    assert dev_people.map(mixed).plan is None
+    same(dev_people.map(mixed).to_rows(), host_people.map(mixed).to_rows())
