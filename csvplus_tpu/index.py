@@ -386,14 +386,15 @@ class Index:
     ResolveDuplicates = resolve_duplicates
 
 
-def load_index(file_name: str) -> Index:
+def load_index(file_name: str, device: "str | None" = None) -> Index:
     """Load an index persisted by :meth:`Index.write_to`
     (csvplus.go:683-705).  Columnar (v2) files restore a device-lazy
-    index; JSONL (v1) files restore a host index."""
+    index (*device* selects placement, like ``on_device``); JSONL (v1)
+    files restore a host index."""
     with open(file_name, "rb") as fb:
         magic2 = fb.read(2)
     if magic2 == b"PK":  # npz container -> columnar v2
-        return _load_columnar(file_name)
+        return _load_columnar(file_name, device)
     with open(file_name, "r", encoding="utf-8") as f:
         try:
             header = json.loads(f.readline())
@@ -414,7 +415,7 @@ def load_index(file_name: str) -> Index:
     return Index(IndexImpl(rows, header["columns"]))
 
 
-def _load_columnar(file_name: str) -> Index:
+def _load_columnar(file_name: str, device: "str | None" = None) -> Index:
     import zipfile
 
     import jax
@@ -432,7 +433,7 @@ def _load_columnar(file_name: str) -> Index:
                     f"{file_name}: unsupported columnar index version "
                     f"{meta.get('version')}"
                 )
-            dev = default_device(None)
+            dev = default_device(device)
             cols = {
                 name: StringColumn(
                     z[f"d:{name}"], jax.device_put(z[f"c:{name}"], dev)

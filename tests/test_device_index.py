@@ -219,3 +219,16 @@ def test_wide_tier_point_bounds_find():
     assert dev_idx._impl.is_lazy  # prefix finds never materialized
     sub = dev_idx.sub_index(probe)
     assert Take(sub).to_rows() == Take(host_idx.sub_index(probe)).to_rows()
+
+
+def test_load_index_device_placement(dev_people, tmp_path):
+    """load_index honors the device argument for the columnar format."""
+    from csvplus_tpu import load_index
+
+    di = dev_people.index_on("id")
+    path = str(tmp_path / "placed.index")
+    di.write_to(path)
+    back = load_index(path, device="cpu")
+    assert back._impl.is_lazy
+    assert len(back) == 120
+    assert back.find("7").to_rows() == di.find("7").to_rows()
