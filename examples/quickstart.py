@@ -65,4 +65,13 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BrokenPipeError:  # e.g. piping into `head`
+        import os, sys
+
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        os._exit(0)
