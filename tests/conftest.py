@@ -167,6 +167,16 @@ def corpus(tmp_path_factory):
         orders_path, ["order_id", "cust_id", "prod_id", "qty", "ts"], orders_rows
     )
 
+    # CSVPLUS_SAVE_TEMPS=dir keeps a copy of the generated corpus for
+    # inspection — the reference's -save-temps flag (csvplus_test.go:1347)
+    save_dir = os.environ.get("CSVPLUS_SAVE_TEMPS")
+    if save_dir:
+        import shutil
+
+        os.makedirs(save_dir, exist_ok=True)
+        for p in (people_path, stock_path, orders_path):
+            shutil.copy2(p, save_dir)
+
     return {
         "people_csv": str(people_path),
         "stock_csv": str(stock_path),
