@@ -45,7 +45,9 @@ def tables(draw, min_rows=0, max_rows=24):
 @st.composite
 def stages(draw):
     kind = draw(
-        st.sampled_from(["filter", "select", "dropc", "top", "drop", "map"])
+        st.sampled_from(
+            ["filter", "select", "dropc", "top", "drop", "map", "tw", "dw"]
+        )
     )
     if kind == "filter":
         preds = st.sampled_from(
@@ -67,6 +69,11 @@ def stages(draw):
         return ("top", draw(st.integers(0, 30)))
     if kind == "drop":
         return ("drop", draw(st.integers(0, 30)))
+    if kind in ("tw", "dw"):
+        preds = st.sampled_from(
+            [Like({"a": "x"}), Not(Like({"b": "y"})), Like({"nope": "q"})]
+        )
+        return (kind, draw(preds))
     return (
         "map",
         draw(
@@ -89,6 +96,10 @@ def apply_stages(src, pipeline):
             src = src.top(arg)
         elif kind == "drop":
             src = src.drop(arg)
+        elif kind == "tw":
+            src = src.take_while(arg)
+        elif kind == "dw":
+            src = src.drop_while(arg)
         else:
             src = src.map(arg)
     return src
