@@ -182,3 +182,40 @@ def test_random_pipeline_sharded_matches_host(rows, pipeline):
         assert dev == host
     else:
         assert dev[0] == "error"
+
+
+@given(tables(min_rows=0, max_rows=20))
+def test_random_json_sink_byte_parity(rows):
+    """to_json: device (vectorized or streamed) == host bytes, any table."""
+    import io
+
+    a, b = io.StringIO(), io.StringIO()
+    take_rows(rows).to_json(a)
+    source_from_table(DeviceTable.from_rows(rows, device="cpu")).to_json(b)
+    assert b.getvalue() == a.getvalue()
+
+
+@given(tables(min_rows=0, max_rows=20))
+def test_random_csv_sink_byte_parity(rows):
+    """to_csv over the columns present in EVERY row: byte parity."""
+    import io
+
+    common = set(_COLS)
+    for r in rows:
+        common &= set(r)
+    cols = sorted(common) or ["a"]
+    a, b = io.StringIO(), io.StringIO()
+    host_err = dev_err = None
+    try:
+        take_rows(rows).to_csv(a, *cols)
+    except DataSourceError as e:
+        host_err = str(e)
+    try:
+        source_from_table(DeviceTable.from_rows(rows, device="cpu")).to_csv(
+            b, *cols
+        )
+    except DataSourceError as e:
+        dev_err = str(e)
+    assert (host_err is None) == (dev_err is None)
+    if host_err is None:
+        assert b.getvalue() == a.getvalue()
