@@ -603,3 +603,31 @@ def test_transform_and_update_symbolic_parity(host_people, dev_people):
     mixed = Update(SetValue("a", "1"), lambda r: r)
     assert dev_people.map(mixed).plan is None
     same(dev_people.map(mixed).to_rows(), host_people.map(mixed).to_rows())
+
+
+def test_wide_tier_join_seeded_sweep():
+    """Wide (host-int64) key tier: 3 seeded content draws of a 2-column
+    join vs host, including misses and duplicate keys."""
+    import random
+
+    from csvplus_tpu import TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    n = 70_000
+    a_vals = [f"a{i:06d}" for i in range(n)]
+    for seed in (1, 2, 3):
+        rng = random.Random(seed)
+        b_vals = [f"b{rng.randrange(n):06d}" for _ in range(n)]
+        rows = [Row({"a": x, "b": y, "v": str(i)})
+                for i, (x, y) in enumerate(zip(a_vals, b_vals))]
+        idx = TakeRows(rows).index_on("a", "b")
+        probes = [Row({"a": a_vals[rng.randrange(n)], "b": rng.choice(b_vals + ["miss"])})
+                  for _ in range(50)]
+        host = TakeRows(probes).join(idx, "a", "b").to_rows()
+        idx.on_device("cpu")
+        assert idx.device_table.packed_i64 is not None
+        dev = source_from_table(
+            DeviceTable.from_rows(probes, device="cpu")
+        ).join(idx, "a", "b").to_rows()
+        assert dev == host
