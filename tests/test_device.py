@@ -631,3 +631,35 @@ def test_wide_tier_join_seeded_sweep():
             DeviceTable.from_rows(probes, device="cpu")
         ).join(idx, "a", "b").to_rows()
         assert dev == host
+
+
+def test_repeated_ingest_no_reference_leak(people_csv):
+    """Repeated OnDevice ingests of the same file release their tables
+    (guards against plan/runner reference cycles pinning device memory)."""
+    import gc
+    import weakref
+
+    from csvplus_tpu.columnar import exec as ex
+
+    refs = []
+    for _ in range(5):
+        src = from_file(people_csv).on_device("cpu")
+        table = src.plan.table
+        refs.append(weakref.ref(table))
+        src.filter(Like({"name": "Ava"})).to_rows()
+        del src, table
+    gc.collect()
+    alive = sum(1 for r in refs if r() is not None)
+    assert alive == 0, f"{alive}/5 ingested tables still referenced"
+
+
+def test_telemetry_report_format(dev_people):
+    from csvplus_tpu import telemetry
+
+    with telemetry.collect():
+        dev_people.filter(Like({"name": "Ava"})).to_rows()
+        report = telemetry.report()
+    lines = report.splitlines()
+    assert lines[0].split() == ["stage", "rows", "in", "rows", "out", "time"]
+    assert any("Filter" in l and "120" in l and "12" in l for l in lines[1:])
+    assert all(l.rstrip().endswith("ms") for l in lines[1:])
