@@ -299,6 +299,11 @@ class Reader:
         This is the rebuild's ``FromFile(...).OnDevice("tpu")`` entry
         point from BASELINE.json's north star.  ``shards=N`` lays the
         columns row-sharded over an N-device mesh (BASELINE config 5).
+
+        NOTE: the file is ingested as a SNAPSHOT at call time; later
+        file modifications are not observed.  The host path re-opens the
+        file on every iteration (reference semantics, csvplus.go:950-959)
+        and does observe them.
         """
         from .columnar.ingest import reader_to_device
 

@@ -213,3 +213,17 @@ def test_file_reader_reiterable(people_csv):
     a = src.to_rows()
     b = src.to_rows()
     assert a == b and len(a) == 120
+
+
+def test_file_reader_sees_updates_between_runs(tmp_path):
+    """The file-backed Reader re-opens its source per iteration, so a
+    pipeline observes file updates (reference maker semantics,
+    csvplus.go:950-959); OnDevice ingests a documented snapshot."""
+    p = tmp_path / "grow.csv"
+    p.write_text("a\n1\n")
+    src = Take(from_file(str(p)))
+    dev = from_file(str(p)).on_device("cpu")  # snapshot now
+    assert len(src.to_rows()) == 1
+    p.write_text("a\n1\n2\n")
+    assert len(src.to_rows()) == 2  # host sees the update
+    assert len(dev.to_rows()) == 1  # device snapshot unchanged
