@@ -39,11 +39,34 @@ def _hermetic_env():
     return env
 
 
+def _ensure_cpu_mesh() -> None:
+    """With no plugin in play, still guarantee a usable 8-device CPU
+    backend even if a stale JAX_PLATFORMS (e.g. 'axon') lingers in the
+    env: JAX snapshots that into its config at import, so the config must
+    be updated directly (no re-exec needed in this branch)."""
+    try:
+        from jax._src import xla_bridge
+
+        if xla_bridge.backends_are_initialized():
+            return
+    except Exception:
+        pass
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def pytest_configure(config):
     if os.environ.get("CSVPLUS_TPU_HERMETIC") == "1":
         return
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
-        return  # no axon plugin in play; module-level defaults suffice
+        _ensure_cpu_mesh()  # no axon plugin; fix config in-process
+        return
     capman = config.pluginmanager.get_plugin("capturemanager")
     if capman is not None:
         try:
