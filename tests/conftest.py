@@ -6,8 +6,10 @@ with random birth years; stock = 8 products; orders = 10 000 random rows.
 Parallel in-memory oracles serve to check pipeline outputs, exactly as the
 reference does (csvplus_test.go:440-451, 559-571).
 
-Device/sharding tests run on a virtual 8-device CPU mesh — the env vars
-must be set before JAX initializes, hence at module import here.
+Device/sharding tests run on a virtual 8-device CPU mesh; the
+pytest_configure hook below makes that hermetic in every environment
+(re-exec when the accelerator plugin is registered, in-process config
+fix otherwise).
 """
 
 import os
