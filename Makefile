@@ -1,0 +1,21 @@
+# Common workflows.  The test harness self-configures a hermetic 8-device
+# CPU mesh regardless of the environment (see tests/conftest.py).
+
+.PHONY: test soak bench dryrun example lint
+
+test:
+	python -m pytest tests/ -x -q
+
+soak:
+	CSVPLUS_HYPOTHESIS_EXAMPLES=1000 python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+dryrun:
+	python __graft_entry__.py
+
+example:
+	python examples/quickstart.py
+	python examples/quickstart.py --device
+	python examples/sharded_join.py
