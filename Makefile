@@ -1,7 +1,7 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench dryrun example lint
+.PHONY: test soak bench dryrun example coldcheck
 
 test:
 	python -m pytest tests/ -x -q
@@ -19,3 +19,11 @@ example:
 	python examples/quickstart.py
 	python examples/quickstart.py --device
 	python examples/sharded_join.py
+
+# clone to a temp dir and run the suite there: verifies the committed
+# state is self-contained (native scanner builds on demand, no stray
+# uncommitted dependencies)
+coldcheck:
+	rm -rf /tmp/csvplus_coldcheck
+	git clone -q . /tmp/csvplus_coldcheck
+	cd /tmp/csvplus_coldcheck && python -m pytest tests/ -x -q
