@@ -68,6 +68,9 @@ def encode_json_body(table: DeviceTable) -> Optional[str]:
         cols.append(col)
     if table.nrows == 0:
         return ""
+    if not names:
+        # Zero columns: every row serializes as the empty object.
+        return "\n,".join(["{}"] * table.nrows) + "\n"
 
     line = None
     for i, (name, col) in enumerate(zip(names, cols)):
@@ -77,7 +80,7 @@ def encode_json_body(table: DeviceTable) -> Optional[str]:
             dtype=np.str_,
         )
         vals = enc[np.asarray(col.codes)]
-        prefix = ("{" if i == 0 else ",") + json.dumps(name) + ":"
+        prefix = ("{" if i == 0 else ",") + json.dumps(name, ensure_ascii=False) + ":"
         piece = np.char.add(prefix, vals)
         line = piece if line is None else np.char.add(line, piece)
     line = np.char.add(line, "}")

@@ -44,17 +44,34 @@ class _View:
     ``full_len`` is the unsliced column length, tracked explicitly so a
     view with zero columns (everything dropped) still knows its row count
     — the host path streams empty rows in that case, and so must we.
+
+    ``scan_base`` is the source row number of full-length row 0 (the
+    originating table's ``row_base``), so ``scan_base + sel[i]`` is the
+    source-convention row number of the i-th streamed row — exact until a
+    Join/Except replaces the row space, which resets it to 0.  This keeps
+    device error row numbers aligned with the host paths' (the host wraps
+    errors with the *originating* source's numbering, e.g. 1-based file
+    records for a Reader, csvplus.go:1080-1146) for sources whose table
+    carries a ``row_base`` — the ``Reader.on_device`` ingest tiers.  The
+    generic ``DataSource.on_device`` route columnarizes an anonymous row
+    stream (base 0), so its errors are numbered by streamed position.
     """
 
-    __slots__ = ("cols", "sel", "device", "full_len")
+    __slots__ = ("cols", "sel", "device", "full_len", "scan_base")
 
     def __init__(
-        self, cols: Dict[str, StringColumn], sel: np.ndarray, device, full_len: int
+        self,
+        cols: Dict[str, StringColumn],
+        sel: np.ndarray,
+        device,
+        full_len: int,
+        scan_base: int = 0,
     ):
         self.cols = cols
         self.sel = sel
         self.device = device
         self.full_len = full_len
+        self.scan_base = scan_base
 
     def materialize(self) -> DeviceTable:
         gathered = {n: c.gather(self.sel) for n, c in self.cols.items()}
@@ -77,6 +94,12 @@ def execute_plan(root: P.PlanNode) -> DeviceTable:
     With :data:`csvplus_tpu.utils.telemetry` enabled, every stage records
     (rows in, rows out, wall time) and shows as a named range in device
     profiles."""
+    return execute_plan_view(root).materialize()
+
+
+def execute_plan_view(root: P.PlanNode) -> "_View":
+    """Run the plan, returning the final executor view (columns +
+    selection vector + source row numbering) without materializing."""
     stages = _linearize(root)
     scan = stages[0]
     assert isinstance(scan, P.Scan)
@@ -92,6 +115,7 @@ def execute_plan(root: P.PlanNode) -> DeviceTable:
         np.arange(table.nrows, dtype=np.int64),
         table.device,
         stored_len,
+        scan_base=getattr(table, "row_base", 0),
     )
 
     from ..utils.observe import telemetry
@@ -101,7 +125,7 @@ def execute_plan(root: P.PlanNode) -> DeviceTable:
             view = _exec_stage(view, node)
             _t["rows_out"] = int(view.sel.shape[0])
 
-    return view.materialize()
+    return view
 
 
 def _exec_stage(view: "_View", node: P.PlanNode) -> "_View":
@@ -182,12 +206,41 @@ def _full_len(view: _View) -> int:
     return view.full_len
 
 
+def first_missing_cell(view: _View, columns):
+    """The first missing cell in streamed **row-major** order — exactly
+    where the host path fails: the first streamed row lacking any of
+    *columns*, and within that row the first such column in argument
+    order.  Returns ``(source row number, column)`` (numbered by the
+    originating source, ``scan_base + original row id``) or None.
+    """
+    best = None  # (streamed position, column)
+    for c in columns:
+        col = view.cols.get(c)
+        if col is None:
+            pos = 0  # missing from the schema: every streamed row lacks it
+        elif col.has_absent:
+            codes = np.asarray(col.codes)[view.sel]
+            bad = np.flatnonzero(codes < 0)
+            if not bad.size:
+                continue
+            pos = int(bad[0])
+        else:
+            continue
+        if best is None or pos < best[0]:
+            best = (pos, c)
+            if pos == 0:
+                break  # nothing can precede streamed row 0
+    if best is None:
+        return None
+    pos, c = best
+    return view.scan_base + int(view.sel[pos]), c
+
+
 def _apply_select(view: _View, columns) -> None:
     """SelectCols with host-parity errors: the host path raises at the
     first *streamed* row lacking the cell (csvplus.go:517-519 via
-    Row.Select), so an empty selection never errors, a schema-missing
-    column errors at position 0, and a heterogeneous absent cell errors
-    at its position within the selection."""
+    Row.Select), so an empty selection never errors, and the error
+    carries the originating source's row number of that row."""
     from .table import StringColumn as _SC
     import numpy as _np
 
@@ -200,15 +253,9 @@ def _apply_select(view: _View, columns) -> None:
             for c in columns
         }
         return
-    for c in columns:
-        if c not in view.cols:
-            raise DataSourceError(0, MissingColumnError(c))
-        col = view.cols[c]
-        if col.has_absent:
-            codes = _np.asarray(col.codes)[view.sel]
-            bad = _np.flatnonzero(codes < 0)
-            if bad.size:
-                raise DataSourceError(int(bad[0]), MissingColumnError(c))
+    bad = first_missing_cell(view, columns)
+    if bad is not None:
+        raise DataSourceError(bad[0], MissingColumnError(bad[1]))
     view.cols = {c: view.cols[c] for c in columns}
 
 

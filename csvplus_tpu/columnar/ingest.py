@@ -45,6 +45,10 @@ def reader_to_device(
     """
     from ..utils.observe import telemetry
 
+    # source row number of data record 0, matching the host Reader's
+    # 1-based record numbering (record 1 is the header when one is read)
+    row_base = 2 if reader._header_from_first_row else 1
+
     path = getattr(reader, "_path", None)
     if path is not None and _device_parse_enabled():
         try:
@@ -58,6 +62,7 @@ def reader_to_device(
                     table = DeviceTable.from_encoded(
                         {n: data[n] for n in names}, nrows, device=device
                     )
+                    table.row_base = row_base
                     _t["rows_out"] = nrows
                 else:
                     _t["discard"] = True
@@ -77,6 +82,7 @@ def reader_to_device(
                     table = DeviceTable.from_encoded(
                         {n: data[n] for n in names}, nrows, device=device
                     )
+                    table.row_base = row_base
                     _t["rows_out"] = nrows
                 else:
                     _t["discard"] = True  # tier declined; python tier records
@@ -87,6 +93,7 @@ def reader_to_device(
     with telemetry.stage("ingest:python", 0) as _t:
         names, data = _read_columns_fast(reader, **opts)
         table = DeviceTable.from_pylists({n: data[n] for n in names}, device=device)
+        table.row_base = row_base
         _t["rows_out"] = table.nrows
     return source_from_table(_maybe_shard(table, shards, mesh))
 

@@ -205,12 +205,23 @@ def merge_with_fallback(primary: StringColumn, fallback: StringColumn) -> String
 
 
 class DeviceTable:
-    """An ordered set of equal-length columns resident on one device."""
+    """An ordered set of equal-length columns resident on one device.
 
-    def __init__(self, columns: Dict[str, StringColumn], nrows: int, device):
+    ``row_base`` is the source row number of table row 0, in the
+    originating source's numbering convention (2 for a Reader ingest of a
+    file with a header row, 1 for a headerless one, 0 for in-memory rows
+    — matching the host paths' ``DataSourceError`` numbering).  It is
+    only meaningful while row i of the table still IS source row i;
+    executor stages that reorder or drop rows reset it to 0.
+    """
+
+    def __init__(
+        self, columns: Dict[str, StringColumn], nrows: int, device, row_base: int = 0
+    ):
         self.columns = columns
         self.nrows = nrows
         self.device = device
+        self.row_base = row_base
 
     @classmethod
     def from_pylists(
@@ -291,7 +302,7 @@ class DeviceTable:
             moved._str_dict = col._str_dict
             moved._has_absent = col._has_absent if not pad else None
             cols[name] = moved
-        return DeviceTable(cols, self.nrows, mesh.devices.flat[0])
+        return DeviceTable(cols, self.nrows, mesh.devices.flat[0], self.row_base)
 
     def short_desc(self) -> str:
         return f"{self.nrows}x{len(self.columns)}[{','.join(self.columns)}]"

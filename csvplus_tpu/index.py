@@ -489,27 +489,27 @@ def create_index(src, columns: Sequence[str]) -> Index:
 
 
 def _create_index_device(plan, columns: Tuple[str, ...]) -> Index:
-    from .columnar.exec import execute_plan
+    from .columnar.exec import execute_plan_view
     from .ops.join import DeviceIndex
     from .ops.sort import sort_table
 
-    table = execute_plan(plan)
-    if table.nrows == 0:
+    view = execute_plan_view(plan)
+    if view.sel.shape[0] == 0:
         # the host build validates per-row (csvplus.go:722-733), so an
         # empty source yields an empty index without any column check
         return Index(IndexImpl([], columns))
-    for col in columns:
-        if col not in table.columns:
-            raise DataSourceError(
-                0, f'missing column "{col}" while creating an index'
-            )
-        codes = np.asarray(table.columns[col].codes)
-        absent = np.flatnonzero(codes < 0)
-        if absent.size:
-            raise DataSourceError(
-                int(absent[0]),
-                f'missing column "{col}" while creating an index',
-            )
+    # the host build raises at the first streamed row lacking a key cell
+    # (row-major, columns in argument order within the row), numbered by
+    # the ORIGINATING source (reader record numbers / 0-based slice
+    # positions) — first_missing_cell reproduces exactly that
+    from .columnar.exec import first_missing_cell
+
+    bad = first_missing_cell(view, columns)
+    if bad is not None:
+        raise DataSourceError(
+            bad[0], f'missing column "{bad[1]}" while creating an index'
+        )
+    table = view.materialize()
     sorted_table = sort_table(table, list(columns))
     dev = DeviceIndex.build(sorted_table, list(columns))
     return Index(IndexImpl(None, columns, dev=dev))

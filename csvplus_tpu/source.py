@@ -376,6 +376,11 @@ class DataSource:
         form for any host source — it streams the rows once, columnarizes
         (heterogeneous schemas allowed; missing cells stay absent), and
         subsequent symbolic stages run as device kernels.
+
+        Error row numbers downstream of this route count streamed rows
+        from 0 (the stream is anonymous here — any upstream numbering is
+        not recoverable); ``FromFile(...).OnDevice()`` preserves the
+        reader's record numbering instead.
         """
         from .columnar.ingest import _maybe_shard, source_from_table
         from .columnar.table import DeviceTable

@@ -131,6 +131,30 @@ def test_json_parity(host_people, dev_people):
     assert a.getvalue() == b.getvalue()
 
 
+def test_json_zero_columns_parity(host_people, dev_people):
+    """A device source with every column dropped still serializes '{}'
+    objects, byte-identical to the host path (advisor regression)."""
+    stage = lambda s: s.drop_columns("id", "name", "surname", "born")
+    a, b = io.StringIO(), io.StringIO()
+    stage(host_people).to_json(a)
+    stage(dev_people).to_json(b)
+    assert a.getvalue() == b.getvalue()
+    assert a.getvalue().startswith("[{}\n,{}\n")
+
+
+def test_json_non_ascii_column_name_parity(tmp_path):
+    """Non-ASCII column names must be raw UTF-8 on the device fast path,
+    like the streaming sink / Go json.Encoder (advisor regression)."""
+    p = str(tmp_path / "caf.csv")
+    with open(p, "w", encoding="utf-8") as f:
+        f.write("café,b\n x,1\ny,2\n")
+    a, b = io.StringIO(), io.StringIO()
+    Take(from_file(p)).to_json(a)
+    from_file(p).on_device("cpu").to_json(b)
+    assert a.getvalue() == b.getvalue()
+    assert '"café"' in b.getvalue() and "\\u" not in b.getvalue()
+
+
 # -- device joins ---------------------------------------------------------
 
 
@@ -304,12 +328,14 @@ def test_join_absent_collision_keeps_index_value(people_csv):
     assert dev[0]["extra"] == "IDX" and dev[1]["extra"] == "S"
 
 
-def test_device_select_missing_column_row_number(dev_people):
-    """Device SelectCols error carries the 0-based row number like the
-    slice iterator (review regression)."""
+def test_device_select_missing_column_row_number(dev_people, host_people):
+    """Device SelectCols error carries the originating source's row number
+    (first streamed record of the reader), like the host path."""
     with pytest.raises(DataSourceError) as e:
         dev_people.select_columns("id", "zzz").to_rows()
-    assert str(e.value) == 'row 0: missing column "zzz"'
+    with pytest.raises(DataSourceError) as eh:
+        host_people.select_columns("id", "zzz").to_rows()
+    assert str(e.value) == str(eh.value) == 'row 2: missing column "zzz"'
 
 
 def test_policy_dedup_invalidates_stale_device_index(people_csv):
@@ -359,6 +385,24 @@ def test_select_columns_absent_cell_errors(people_csv):
     assert 'missing column "b"' in str(e.value)
     # empty selection: no rows streamed -> no error, like the host path
     assert dev.top(0).select_columns("zzz").to_rows() == []
+
+
+def test_select_columns_row_major_failure_order():
+    """With absent cells in several selected columns the error is the
+    host's: first streamed row missing any column, first such column
+    within it (review regression)."""
+    from csvplus_tpu import TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    rows = [Row({"a": "1", "b": "2"}), Row({"a": "3"}), Row({"b": "4"})]
+    with pytest.raises(DataSourceError) as eh:
+        TakeRows(rows).select_columns("a", "b").to_rows()
+    dev = source_from_table(DeviceTable.from_rows(rows, device="cpu"))
+    with pytest.raises(DataSourceError) as ed:
+        dev.select_columns("a", "b").to_rows()
+    assert str(ed.value) == str(eh.value)
+    assert 'missing column "b"' in str(ed.value)  # row 1 fails on "b" first
 
 
 def test_filter_after_dropping_all_columns(dev_people, host_people):
@@ -416,7 +460,7 @@ def test_telemetry_fallback_exception_transparent(dev_people):
         # DataSourceError keeps its row number through telemetry
         with pytest.raises(DataSourceError) as e:
             dev_people.select_columns("zzz").to_rows()
-        assert str(e.value) == 'row 0: missing column "zzz"'
+        assert str(e.value) == 'row 2: missing column "zzz"'
 
 
 def test_telemetry_native_tier_decline_not_recorded(tmp_path):

@@ -52,6 +52,49 @@ def test_device_index_missing_column(dev_people):
     assert str(e.value).endswith('missing column "xxx" while creating an index')
 
 
+def test_device_index_absent_cell_row_number_parity():
+    """The device build reports the absent-key row in the originating
+    source's numbering, matching the host build (advisor regression) —
+    including through a prior filter (selection vector != identity)."""
+    from csvplus_tpu import Not, Like, Row, TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    rows = [
+        Row({"k": "drop", "v": "0"}),
+        Row({"v": "no-key"}),
+        Row({"k": "b", "v": "2"}),
+    ]
+    host_src = TakeRows(rows).filter(Not(Like({"k": "drop"})))
+    dev_src = source_from_table(DeviceTable.from_rows(rows, device="cpu")).filter(
+        Not(Like({"k": "drop"}))
+    )
+    with pytest.raises(DataSourceError) as eh:
+        host_src.index_on("k")
+    with pytest.raises(DataSourceError) as ed:
+        dev_src.index_on("k")
+    assert str(ed.value) == str(eh.value)  # same row number, same message
+
+
+def test_device_index_missing_cell_row_major_parity():
+    """Row-major failure order: an absent cell in an earlier key column at
+    streamed row 0 wins over a schema-missing later column (review
+    regression)."""
+    from csvplus_tpu import Row, TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    rows = [Row({"v": "x"}), Row({"k": "a", "v": "y"})]
+    with pytest.raises(DataSourceError) as eh:
+        TakeRows(rows).index_on("k", "zzz")
+    with pytest.raises(DataSourceError) as ed:
+        source_from_table(DeviceTable.from_rows(rows, device="cpu")).index_on(
+            "k", "zzz"
+        )
+    assert str(ed.value) == str(eh.value)
+    assert 'missing column "k"' in str(ed.value)
+
+
 def test_device_unique_index(dev_people, host_people):
     assert len(dev_people.unique_index_on("id")) == 120
     with pytest.raises(CsvPlusError) as e:
