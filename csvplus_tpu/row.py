@@ -104,32 +104,43 @@ class Row(dict):
         """Value of *column* as int (csvplus.go:165-183).
 
         Unlike Python's ``int()``, the reference's ``strconv.Atoi`` rejects
-        surrounding whitespace and underscores; we match that strictness.
+        surrounding whitespace and underscores, and is 64-bit: values
+        outside int64 are a ``value out of range`` error, not a bignum.
         """
         if column not in self:
             raise MissingColumnError(column)
         val = self[column]
-        if _GO_INT_RE.match(val):
-            try:
-                return int(val, 10)
-            except ValueError:
-                pass
+        if not _GO_INT_RE.match(val):
+            raise ConversionError(
+                f'column "{column}": cannot convert "{val}" to integer: invalid syntax'
+            )
+        # avoid CPython's 4300-digit int() limit: only the significant
+        # digits matter (Go parses any number of leading zeros)
+        digits = val.lstrip("+-").lstrip("0")
+        if len(digits) > 19:  # > int64 for sure
+            v = None
+        else:
+            v = int(digits or "0", 10)
+            if val[0] == "-":
+                v = -v
+        if v is not None and -(1 << 63) <= v < (1 << 63):
+            return v
         raise ConversionError(
-            f'column "{column}": cannot convert "{val}" to integer: invalid syntax'
+            f'column "{column}": cannot convert "{val}" to integer: value out of range'
         )
 
     def value_as_float(self, column: str) -> float:
-        """Value of *column* as float (csvplus.go:187-205)."""
+        """Value of *column* as float (csvplus.go:187-205), accepting the
+        full ``strconv.ParseFloat`` grammar — decimal/exponent forms,
+        inf/infinity/nan spellings, hex floats, underscore separators."""
         if column not in self:
             raise MissingColumnError(column)
         val = self[column]
-        if _GO_FLOAT_RE.match(val):
-            try:
-                return float(val)
-            except (ValueError, OverflowError):
-                pass
+        res = parse_go_float(val)
+        if isinstance(res, float):
+            return res
         raise ConversionError(
-            f'column "{column}": cannot convert "{val}" to float: invalid syntax'
+            f'column "{column}": cannot convert "{val}" to float: {res}'
         )
 
     # Go-style aliases (the reference API names, csvplus.go:61-205) --------
@@ -146,14 +157,90 @@ class Row(dict):
 
 import re as _re
 
-# strconv.Atoi: optional sign + decimal digits only.
+# strconv.Atoi: optional sign + decimal digits only (no underscores —
+# Atoi parses with an explicit base, where Go disallows separators).
 _GO_INT_RE = _re.compile(r"^[+-]?[0-9]+$")
-# strconv.ParseFloat accepts decimal/exponent forms, inf/nan, hex floats.
-# We accept the common decimal forms; Python float() covers inf/nan spellings
-# that Go also accepts ("inf", "Infinity", "NaN" case-insensitively).
-_GO_FLOAT_RE = _re.compile(
-    r"^[+-]?((\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?|[iI][nN][fF]([iI][nN][iI][tT][yY])?|[nN][aA][nN])$"
+# ParseFloat specials: inf/infinity take an optional sign, nan does NOT
+# (Go's special() only matches a bare "nan").
+_GO_SPECIAL_RE = _re.compile(r"^(?:[+-]?(?i:inf(?:inity)?)|(?i:nan))$")
+# Hex float: binary ("p") exponent REQUIRED, >=1 mantissa digit overall.
+_GO_HEX_RE = _re.compile(
+    r"^[+-]?0[xX](?P<i>[0-9a-fA-F]*)(?:\.(?P<f>[0-9a-fA-F]*))?[pP][+-]?[0-9]+$"
 )
+# Decimal: >=1 mantissa digit; exponent digits required when e present.
+_GO_DEC_RE = _re.compile(r"^[+-]?(?:[0-9]+(?:\.[0-9]*)?|\.[0-9]+)(?:[eE][+-]?[0-9]+)?$")
+
+
+def _underscores_ok(s: str) -> bool:
+    """Go's digit-separator placement rule for numeric literals: every
+    underscore sits between two digits, or between the base prefix and a
+    digit (strconv's underscoreOK semantics)."""
+    if s[:1] in ("+", "-"):
+        s = s[1:]
+    saw = "^"  # ^ start, 0 digit/base-prefix, _ underscore, ! other
+    i = 0
+    is_hex = False
+    if len(s) >= 2 and s[0] == "0" and s[1] in "bBoOxX":
+        i = 2
+        saw = "0"  # the base prefix counts as a digit for separators
+        is_hex = s[1] in "xX"
+    while i < len(s):
+        c = s[i]
+        if "0" <= c <= "9" or (is_hex and c in "abcdefABCDEF"):
+            saw = "0"
+        elif c == "_":
+            if saw != "0":
+                return False
+            saw = "_"
+        else:
+            if saw == "_":
+                return False
+            saw = "!"
+        i += 1
+    return saw != "_"
+
+
+def parse_go_float(s: str):
+    """``strconv.ParseFloat(s, 64)`` (Go grammar and range semantics).
+
+    Returns the parsed float, or the Go error suffix as a plain string —
+    ``"invalid syntax"`` or ``"value out of range"`` (overflow to ±Inf
+    and complete underflow to 0 are range errors in Go).
+    """
+    if _GO_SPECIAL_RE.match(s):
+        low = s.lstrip("+-").lower()
+        if low == "nan":
+            return float("nan")
+        return float("-inf") if s[0] == "-" else float("inf")
+    t = s
+    if "_" in t:
+        if not _underscores_ok(t):
+            return "invalid syntax"
+        t = t.replace("_", "")
+    m = _GO_HEX_RE.match(t)
+    if m:
+        mantissa = (m.group("i") or "") + (m.group("f") or "")
+        if not mantissa:
+            return "invalid syntax"  # "0x.p1" — no mantissa digits
+        try:
+            v = float.fromhex(t)
+        except OverflowError:
+            return "value out of range"
+        except ValueError:
+            return "invalid syntax"
+    elif _GO_DEC_RE.match(t):
+        mantissa = _re.split(r"[eE]", t, maxsplit=1)[0]
+        try:
+            v = float(t)
+        except (ValueError, OverflowError):
+            return "value out of range"
+    else:
+        return "invalid syntax"
+    if v in (float("inf"), float("-inf")):
+        return "value out of range"
+    if v == 0.0 and any(c in "123456789abcdefABCDEF" for c in mantissa):
+        return "value out of range"
+    return v
 
 
 def merge_rows(left: Row, right: Row) -> Row:

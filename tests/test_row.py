@@ -93,6 +93,117 @@ def test_value_as_float():
         Row({"x": ""}).value_as_float("x")
 
 
+# Corpus of (input, expected) pinning Go's strconv.ParseFloat(s, 64)
+# grammar (csvplus.go:196): value for valid inputs, or the strconv
+# error suffix. Derived from the Go language spec's float literal
+# grammar and strconv's documented range semantics.
+_GO_FLOAT_CORPUS = [
+    # decimal forms
+    ("0", 0.0), ("-0", -0.0), ("3.1415926", 3.1415926), ("5.", 5.0),
+    (".5", 0.5), ("1e3", 1000.0), ("1E-3", 0.001), ("+2e+2", 200.0),
+    # specials: inf takes a sign, nan does not
+    ("inf", float("inf")), ("-Inf", float("-inf")), ("+INFINITY", float("inf")),
+    ("nan", "nan"), ("NaN", "nan"), ("+nan", "invalid syntax"),
+    ("-nan", "invalid syntax"), ("infin", "invalid syntax"),
+    # hex floats: binary exponent required
+    ("0x1p-2", 0.25), ("-0x1.8p1", -3.0), ("0X2P3", 16.0),
+    ("0x.8p1", 1.0), ("0x1.p1", 2.0),
+    ("0x1", "invalid syntax"), ("0x1.8", "invalid syntax"),
+    ("0x.p1", "invalid syntax"), ("0xp1", "invalid syntax"),
+    ("0x1q1", "invalid syntax"),
+    # underscore separators: between digits / after the base prefix only
+    ("1_000.5", 1000.5), ("1_2e3_4", 12e34), ("0x_1p4", 16.0),
+    ("0x1_fp0", 31.0), ("_1", "invalid syntax"), ("1_", "invalid syntax"),
+    ("1__2", "invalid syntax"), ("1_.2", "invalid syntax"),
+    ("1._2", "invalid syntax"), ("1e_2", "invalid syntax"),
+    ("1_e2", "invalid syntax"),
+    # range: overflow to ±Inf and complete underflow to 0 are errors
+    ("1e999", "value out of range"), ("-1e999", "value out of range"),
+    ("1e-999", "value out of range"), ("0x1p99999", "value out of range"),
+    ("5e-324", 5e-324), ("1.7976931348623157e308", 1.7976931348623157e308),
+    ("0.0e-999", 0.0), ("0x0p-99999", 0.0),
+    # junk
+    ("", "invalid syntax"), (" 1", "invalid syntax"), ("1 ", "invalid syntax"),
+    ("1.2.3", "invalid syntax"), ("e5", "invalid syntax"),
+    ("1e", "invalid syntax"), (".", "invalid syntax"), ("+", "invalid syntax"),
+    ("0b101", "invalid syntax"),
+]
+
+
+def test_value_as_float_go_grammar_corpus():
+    """Full strconv.ParseFloat grammar: hex floats, underscores, specials,
+    range errors (csvplus.go:187-205; VERDICT round-1 item 8)."""
+    import math
+    from csvplus_tpu.row import parse_go_float
+
+    for s, want in _GO_FLOAT_CORPUS:
+        got = parse_go_float(s)
+        if want == "nan":
+            assert isinstance(got, float) and math.isnan(got), (s, got)
+        elif isinstance(want, str):
+            assert got == want, (s, got, want)
+            row = Row({"x": s})
+            with pytest.raises(ConversionError) as e:
+                row.value_as_float("x")
+            assert str(e.value) == f'column "x": cannot convert "{s}" to float: {want}'
+        else:
+            assert isinstance(got, float) and got == want, (s, got, want)
+            if s == "-0":
+                assert math.copysign(1.0, got) == -1.0
+
+
+def test_value_as_int_int64_range():
+    """Go's Atoi is 64-bit: out-of-range magnitudes error instead of
+    returning a bignum."""
+    assert Row({"x": "9223372036854775807"}).value_as_int("x") == 2**63 - 1
+    assert Row({"x": "-9223372036854775808"}).value_as_int("x") == -(2**63)
+    with pytest.raises(ConversionError) as e:
+        Row({"x": "9223372036854775808"}).value_as_int("x")
+    assert str(e.value).endswith("value out of range")
+    # beyond CPython's int-conversion digit limit: still a range error,
+    # never a raw ValueError (review regression)
+    with pytest.raises(ConversionError) as e:
+        Row({"x": "1" * 5000}).value_as_int("x")
+    assert str(e.value).endswith("value out of range")
+    # leading zeros and signed zeros parse like Go's Atoi (review regr.)
+    assert Row({"x": "0" * 4999 + "9"}).value_as_int("x") == 9
+    assert Row({"x": "-0"}).value_as_int("x") == 0
+    assert Row({"x": "+0000"}).value_as_int("x") == 0
+    assert Row({"x": "-0007"}).value_as_int("x") == -7
+
+
+def test_value_as_float_property_vs_python():
+    """Property: on plain decimal literals (the common case) the Go
+    grammar agrees with Python's float() after underscore stripping."""
+    from hypothesis import given, strategies as st
+    from csvplus_tpu.row import parse_go_float
+
+    digits = st.text("0123456789", min_size=1, max_size=12)
+
+    @given(
+        sign=st.sampled_from(["", "+", "-"]),
+        intpart=digits,
+        frac=st.none() | digits,
+        exp=st.none() | st.tuples(st.sampled_from(["e", "E"]),
+                                  st.sampled_from(["", "+", "-"]),
+                                  st.text("0123456789", min_size=1, max_size=3)),
+    )
+    def check(sign, intpart, frac, exp):
+        s = sign + intpart + ("." + frac if frac is not None else "")
+        if exp is not None:
+            s += exp[0] + exp[1] + exp[2]
+        expected = float(s)
+        got = parse_go_float(s)
+        if expected in (float("inf"), float("-inf")) or (
+            expected == 0.0 and any(c in "123456789" for c in s.split("e")[0].split("E")[0])
+        ):
+            assert got == "value out of range", (s, got)
+        else:
+            assert got == expected, (s, got)
+
+    check()
+
+
 def test_merge_rows_right_wins():
     from csvplus_tpu import merge_rows
 
