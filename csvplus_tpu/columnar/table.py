@@ -283,10 +283,10 @@ class DeviceTable:
         partitioned join (csvplus_tpu/parallel/pjoin.py) remains the
         hand-optimized path for very large build sides.
         """
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        from ..parallel.mesh import AXIS
+        from jax.sharding import NamedSharding
+        from ..parallel.mesh import row_spec
 
-        sharding = NamedSharding(mesh, P(AXIS))
+        sharding = NamedSharding(mesh, row_spec(mesh))
         n_dev = mesh.devices.size
         pad = (-self.nrows) % n_dev  # NamedSharding needs divisibility
         cols = {}

@@ -1,14 +1,14 @@
-"""Multi-chip execution: mesh utilities, sharded tables, partitioned joins.
+"""Multi-chip execution: mesh utilities and partitioned joins.
 
 The reference is strictly single-threaded (SURVEY.md §2: no goroutines,
 no channels).  This package is the rebuild's first-class replacement for
 that absent layer, per BASELINE.json config 5: row-sharded column stores
+(``DeviceTable.with_sharding`` — the one sharded-table abstraction)
 over a 1-D ``jax.sharding.Mesh``, broadcast joins for small build sides,
 and a range-partitioned lookup join whose key shuffle rides ICI
 ``lax.all_to_all`` inside ``shard_map``.
 """
 
 from .mesh import make_mesh, shard_rows, replicate
-from .sharded import ShardedTable
 
-__all__ = ["make_mesh", "shard_rows", "replicate", "ShardedTable"]
+__all__ = ["make_mesh", "shard_rows", "replicate"]

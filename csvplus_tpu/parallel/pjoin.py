@@ -49,9 +49,19 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import AXIS, pad_to_multiple
+from .mesh import pad_to_multiple, row_spec
 
 _SENTINEL = np.int32(np.iinfo(np.int32).max)
+
+
+def _flat_axis_index(axes: "tuple[str, ...]"):
+    """The device's flattened index over the (possibly multi-axis) mesh,
+    in row-major axis order — matching both ``row_spec`` data layout and
+    ``all_to_all`` over the same axis tuple."""
+    idx = lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * lax.psum(1, a) + lax.axis_index(a)
+    return idx
 
 
 def partition_sorted_keys(
@@ -97,9 +107,11 @@ def partition_sorted_keys(
     return local, splits, bounds.astype(np.int32)
 
 
-def _probe_shard_kernel(n_shards: int, capacity: int, qk, keys_local, splits, base):
+def _probe_shard_kernel(n_shards: int, capacity: int, axes, qk, keys_local, splits, base):
     """Per-shard body (runs under shard_map): route, exchange, probe,
-    route back.  All shapes static."""
+    route back.  All shapes static.  *axes* is the mesh's full axis-name
+    tuple: the exchange spans the whole mesh (ICI within a slice, DCN
+    across slices on a 2-D mesh)."""
     m = qk.shape[0]
     N, C = n_shards, capacity
 
@@ -128,23 +140,23 @@ def _probe_shard_kernel(n_shards: int, capacity: int, qk, keys_local, splits, ba
     buf = buf.at[safe_dest, jnp.where(ok, rank, C)].set(qk_s, mode="drop")
 
     # ICI shuffle: slot-aligned exchange
-    recv = lax.all_to_all(buf, AXIS, split_axis=0, concat_axis=0, tiled=True)
+    recv = lax.all_to_all(buf, axes, split_axis=0, concat_axis=0, tiled=True)
 
     # vectorized local binary search over this shard's slice
     q = recv.reshape(-1)
     lo = jnp.searchsorted(keys_local, q, side="left")
     hi = jnp.searchsorted(keys_local, q, side="right")
     found = (hi > lo) & (q >= 0)
-    my_base = base[lax.axis_index(AXIS)]
+    my_base = base[_flat_axis_index(axes)]
     resp_lo = jnp.where(found, lo.astype(jnp.int32) + my_base, -1)
     resp_ct = jnp.where(found, (hi - lo).astype(jnp.int32), 0)
 
     # answers ride home through the same slots
     back_lo = lax.all_to_all(
-        resp_lo.reshape(N, C), AXIS, split_axis=0, concat_axis=0, tiled=True
+        resp_lo.reshape(N, C), axes, split_axis=0, concat_axis=0, tiled=True
     )
     back_ct = lax.all_to_all(
-        resp_ct.reshape(N, C), AXIS, split_axis=0, concat_axis=0, tiled=True
+        resp_ct.reshape(N, C), axes, split_axis=0, concat_axis=0, tiled=True
     )
 
     safe_rank = jnp.clip(rank, 0, C - 1)
@@ -162,11 +174,13 @@ def _probe_shard_kernel(n_shards: int, capacity: int, qk, keys_local, splits, ba
 
 @partial(jax.jit, static_argnames=("mesh", "n_shards", "capacity"))
 def _probe_spmd(mesh, n_shards, capacity, qk_sharded, keys_local, splits, base):
+    axes = tuple(mesh.axis_names)
+    rows = P(axes)
     f = shard_map(
-        partial(_probe_shard_kernel, n_shards, capacity),
+        partial(_probe_shard_kernel, n_shards, capacity, axes),
         mesh=mesh,
-        in_specs=(P(AXIS), P(AXIS), P(), P()),
-        out_specs=(P(AXIS), P(AXIS)),
+        in_specs=(rows, rows, P(), P()),
+        out_specs=(rows, rows),
     )
     return f(qk_sharded, keys_local, splits, base)
 
@@ -179,7 +193,7 @@ def prepare_partitioned(mesh: Mesh, index_keys_sorted: np.ndarray):
         index_keys_sorted.astype(np.int32), n_shards
     )
     return (
-        jax.device_put(local.reshape(-1), NamedSharding(mesh, P(AXIS))),
+        jax.device_put(local.reshape(-1), NamedSharding(mesh, row_spec(mesh))),
         jax.device_put(splits, NamedSharding(mesh, P())),
         jax.device_put(base, NamedSharding(mesh, P())),
     )
@@ -243,7 +257,7 @@ def partitioned_probe(
         capacity = max(64, 2 * ((m_per_shard + n_shards - 1) // n_shards))
     capacity = 1 << (int(capacity) - 1).bit_length()  # pow2 buckets limit recompiles
 
-    qk_dev = jax.device_put(qk, NamedSharding(mesh, P(AXIS)))
+    qk_dev = jax.device_put(qk, NamedSharding(mesh, row_spec(mesh)))
 
     while True:
         lo, ct = _probe_spmd(

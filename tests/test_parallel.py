@@ -14,7 +14,6 @@ from csvplus_tpu.parallel.pjoin import (
     partition_sorted_keys,
     partitioned_probe,
 )
-from csvplus_tpu.parallel.sharded import ShardedTable
 
 
 @pytest.fixture(scope="module")
@@ -27,15 +26,18 @@ def test_eight_devices_available():
 
 
 def test_sharded_table_roundtrip(people_csv, mesh):
-    from csvplus_tpu.columnar.ingest import reader_to_device
+    """with_sharding (the one sharded-table abstraction) pads to shard
+    divisibility without leaking padding into results."""
     from csvplus_tpu import from_file as ff
 
     dev = ff(people_csv).on_device("cpu")
     from csvplus_tpu.columnar.exec import execute_plan
 
     table = execute_plan(dev.plan)
-    st = ShardedTable.from_table(table, mesh)
-    assert st.nrows == 120 and st.padded % 8 == 0
+    st = table.with_sharding(mesh)
+    assert st.nrows == 120
+    col = next(iter(st.columns.values()))
+    assert len(col) % 8 == 0  # stored length padded for the mesh
     assert st.to_rows() == table.to_rows()
 
 
@@ -58,6 +60,26 @@ def test_partitioned_probe_differential(mesh):
     queries = rng.integers(-10, 6000, size=30_001).astype(np.int32)
     queries[queries < 0] = -1
     lo, ct = partitioned_probe(mesh, queries, keys)
+    olo = np.searchsorted(keys, queries, side="left").astype(np.int32)
+    oct_ = (np.searchsorted(keys, queries, side="right") - olo).astype(np.int32)
+    oct_[queries < 0] = 0
+    assert (ct == oct_).all()
+    hit = ct > 0
+    assert (lo[hit] == olo[hit]).all()
+
+
+def test_partitioned_probe_2d_mesh_differential():
+    """The all-to-all exchange spans BOTH axes of a (slice, chip) mesh —
+    routing uses the flattened device index, so no probe is misrouted
+    (review regression: 2-D meshes silently dropped matches)."""
+    from csvplus_tpu.parallel.mesh import make_mesh_2d
+
+    mesh2 = make_mesh_2d(2, 4)
+    rng = np.random.default_rng(5)
+    keys = np.sort(rng.integers(0, 5000, size=20_000).astype(np.int32))
+    queries = rng.integers(-10, 6000, size=30_001).astype(np.int32)
+    queries[queries < 0] = -1
+    lo, ct = partitioned_probe(mesh2, queries, keys)
     olo = np.searchsorted(keys, queries, side="left").astype(np.int32)
     oct_ = (np.searchsorted(keys, queries, side="right") - olo).astype(np.int32)
     oct_[queries < 0] = 0
@@ -165,6 +187,34 @@ def test_dryrun_multichip_runs():
     fn, args = __graft_entry__.entry()
     out = jax.jit(fn)(*args)
     assert len(out) == 3
+
+
+def test_two_d_mesh_pipeline_parity(people_csv, orders_csv):
+    """(slice, chip) mesh: rows shard over both axes; filter/select/join
+    parity with the host path (VERDICT round-1 item 10)."""
+    from csvplus_tpu.parallel.mesh import make_mesh_2d, row_spec
+
+    mesh2 = make_mesh_2d(2, 4)
+    assert mesh2.axis_names == ("slice", "shards")
+    assert row_spec(mesh2) == jax.sharding.PartitionSpec(("slice", "shards"))
+    idx = Take(from_file(people_csv)).unique_index_on("id")
+    idx.on_device("cpu")
+    host = (
+        Take(from_file(orders_csv))
+        .select_columns("cust_id", "qty")
+        .join(idx, "cust_id")
+        .top(500)
+        .to_rows()
+    )
+    dev = (
+        from_file(orders_csv)
+        .on_device("cpu", mesh=mesh2)
+        .select_columns("cust_id", "qty")
+        .join(idx, "cust_id")
+        .top(500)
+        .to_rows()
+    )
+    assert dev == host
 
 
 # -- SPMD pipeline via sharded DeviceTables (OnDevice(shards=N)) ----------
