@@ -48,7 +48,8 @@ class _View:
     ``scan_base`` is the source row number of full-length row 0 (the
     originating table's ``row_base``), so ``scan_base + sel[i]`` is the
     source-convention row number of the i-th streamed row — exact until a
-    Join/Except replaces the row space, which resets it to 0.  This keeps
+    Join replaces the row space, which resets it to 0 (Except merely
+    narrows the selection, so it preserves the numbering).  This keeps
     device error row numbers aligned with the host paths' (the host wraps
     errors with the *originating* source's numbering, e.g. 1-based file
     records for a Reader, csvplus.go:1080-1146) for sources whose table
@@ -170,10 +171,11 @@ def _exec_stage(view: "_View", node: P.PlanNode) -> "_View":
         dev_index = node.index.device_table
         if dev_index is None or not dev_index.supported:
             raise UnsupportedPlan("join build side has no packed device index")
+        _check_key_cells(view, node.columns)
         stream = view.materialize()
         try:
             joined = J.join_tables(stream, dev_index, list(node.columns))
-        except MissingColumnError as e:
+        except MissingColumnError as e:  # backstop; _check_key_cells covers it
             raise DataSourceError(0, e) from e
         view = _View(
             dict(joined.columns),
@@ -185,17 +187,15 @@ def _exec_stage(view: "_View", node: P.PlanNode) -> "_View":
         dev_index = node.index.device_table
         if dev_index is None or not dev_index.supported:
             raise UnsupportedPlan("except build side has no packed device index")
+        _check_key_cells(view, node.columns)
         stream = view.materialize()
         try:
             keep = J.except_mask(stream, dev_index, list(node.columns))
-        except MissingColumnError as e:
+        except MissingColumnError as e:  # backstop; _check_key_cells covers it
             raise DataSourceError(0, e) from e
-        view = _View(
-            dict(stream.columns),
-            np.flatnonzero(keep).astype(np.int64),
-            stream.device,
-            stream.nrows,
-        )
+        # except_ passes rows through 1:1, so keep the original row space
+        # (and its scan_base numbering): just narrow the selection
+        view.sel = view.sel[np.asarray(keep, dtype=bool)]
     else:
         raise UnsupportedPlan(f"no device lowering for {type(node).__name__}")
 
@@ -204,6 +204,18 @@ def _exec_stage(view: "_View", node: P.PlanNode) -> "_View":
 
 def _full_len(view: _View) -> int:
     return view.full_len
+
+
+def _check_key_cells(view: _View, columns) -> None:
+    """Host-parity key validation for Join/Except: the host probe calls
+    ``select_values`` per streamed row (csvplus.go:556,599), so the error
+    is the first streamed row lacking a key cell, in the originating
+    source's numbering; an empty stream never errors."""
+    if view.sel.shape[0] == 0:
+        return
+    bad = first_missing_cell(view, columns)
+    if bad is not None:
+        raise DataSourceError(bad[0], MissingColumnError(bad[1]))
 
 
 def first_missing_cell(view: _View, columns):

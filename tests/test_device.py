@@ -291,6 +291,45 @@ def test_rename_collision_parity(host_people, dev_people):
     same(dev_people.map(chained).to_rows(), host_people.map(chained).to_rows())
 
 
+def test_join_missing_key_column_row_number_parity(people_csv, orders_csv):
+    """Join/Except on a key column absent from the stream reports the
+    host's row number — the reader's first data record (review regr.)."""
+    idx = Take(from_file(people_csv)).unique_index_on("id")
+    idx.on_device("cpu")
+    with pytest.raises(DataSourceError) as eh:
+        Take(from_file(orders_csv)).join(idx, "zzz").to_rows()
+    with pytest.raises(DataSourceError) as ed:
+        from_file(orders_csv).on_device("cpu").join(idx, "zzz").to_rows()
+    assert str(ed.value) == str(eh.value) == 'row 2: missing column "zzz"'
+    with pytest.raises(DataSourceError) as ed2:
+        from_file(orders_csv).on_device("cpu").except_(idx, "zzz").to_rows()
+    assert str(ed2.value) == str(eh.value)
+
+
+def test_except_preserves_source_row_numbers(people_csv, orders_csv):
+    """except_ passes rows through 1:1, so errors AFTER it still carry
+    the originating reader's record numbers (review regression)."""
+    # index over a subset of ids, so some orders rows SURVIVE the except_
+    idx = Take(from_file(people_csv)).top(10).unique_index_on("id")
+    idx.on_device("cpu")
+    with pytest.raises(DataSourceError) as eh:
+        (
+            Take(from_file(orders_csv))
+            .except_(idx, "cust_id")
+            .select_columns("zzz")
+            .to_rows()
+        )
+    with pytest.raises(DataSourceError) as ed:
+        (
+            from_file(orders_csv)
+            .on_device("cpu")
+            .except_(idx, "cust_id")
+            .select_columns("zzz")
+            .to_rows()
+        )
+    assert str(ed.value) == str(eh.value)
+
+
 def test_join_absent_key_cell_errors(people_csv):
     """A heterogeneous stream row lacking the join-key cell errors like the
     host path (review regression)."""
@@ -626,12 +665,13 @@ def test_take_of_device_table_escape_hatch(dev_people, host_people):
 
 def test_sharded_table_from_pylists():
     from csvplus_tpu.parallel.mesh import make_mesh
-    from csvplus_tpu.parallel.sharded import ShardedTable
+    from csvplus_tpu.columnar.table import DeviceTable
 
-    st = ShardedTable.from_pylists(
-        {"a": [str(i) for i in range(11)]}, make_mesh(8)
-    )
-    assert st.nrows == 11 and st.padded % 8 == 0
+    st = DeviceTable.from_pylists(
+        {"a": [str(i) for i in range(11)]}, device="cpu"
+    ).with_sharding(make_mesh(8))
+    assert st.nrows == 11
+    assert len(st.columns["a"]) % 8 == 0  # padded for shard divisibility
     assert [r["a"] for r in st.to_rows()] == [str(i) for i in range(11)]
 
 
