@@ -12,10 +12,13 @@ columnar device kernels:
   (a rename or constant write never touches row data);
 * ``Join``/``Except`` -> packed-key probe kernels (:mod:`..ops.join`).
 
-Execution keeps a **selection vector** (host int64 row ids) over
-full-length device columns and materializes gathers as late as possible;
-the only per-row host work is the final string decode at the sink
-boundary.
+Execution keeps a **selection vector** (device int32 row ids) over
+full-length device columns and materializes gathers as late as possible.
+Data-dependent control flow stays on device — filters compact the
+selection with a device boolean gather, windowing cuts come from a
+device argmax — so the only values crossing to host per stage are O(1)
+scalars (result sizes), and the only per-row host work is the final
+string decode at the sink boundary.
 
 Anything not expressible returns ``None`` from :func:`try_execute_plan`,
 and the caller falls back to the host streaming path — behavior parity
@@ -111,9 +114,11 @@ def execute_plan_view(root: P.PlanNode) -> "_View":
     stored_len = (
         len(next(iter(table.columns.values()))) if table.columns else table.nrows
     )
+    import jax.numpy as jnp
+
     view = _View(
         dict(table.columns),
-        np.arange(table.nrows, dtype=np.int64),
+        jnp.arange(table.nrows, dtype=jnp.int32),
         table.device,
         stored_len,
         scan_base=getattr(table, "row_base", 0),
@@ -134,23 +139,29 @@ def _exec_stage(view: "_View", node: P.PlanNode) -> "_View":
     from ..ops.filter import UnsupportedPredicate, build_mask
     from ..ops import join as J
 
+    import jax.numpy as jnp
+
     if isinstance(node, P.Filter):
         nrows = _full_len(view)
         try:
             mask = build_mask(view.cols, nrows, node.pred)
         except UnsupportedPredicate as e:
             raise UnsupportedPlan(str(e)) from e
-        mask_np = np.asarray(mask)
-        view.sel = view.sel[mask_np[view.sel]]
+        # device compaction: boolean gather over the selection; only the
+        # compacted size crosses to host (implicit in the eager shape)
+        view.sel = view.sel[jnp.take(mask, view.sel, axis=0)]
     elif isinstance(node, P.TakeWhile) or isinstance(node, P.DropWhile):
         nrows = _full_len(view)
         try:
             mask = build_mask(view.cols, nrows, node.pred)
         except UnsupportedPredicate as e:
             raise UnsupportedPlan(str(e)) from e
-        mask_sel = np.asarray(mask)[view.sel]
-        false_pos = np.flatnonzero(~mask_sel)
-        cut = int(false_pos[0]) if false_pos.size else view.sel.shape[0]
+        stop = ~jnp.take(mask, view.sel, axis=0)
+        # device argmax finds the first false; two O(1) scalar syncs
+        if bool(jnp.any(stop)):
+            cut = int(jnp.argmax(stop))
+        else:
+            cut = int(view.sel.shape[0])
         if isinstance(node, P.TakeWhile):
             view.sel = view.sel[:cut]  # stop permanently at first false
         else:
@@ -179,7 +190,7 @@ def _exec_stage(view: "_View", node: P.PlanNode) -> "_View":
             raise DataSourceError(0, e) from e
         view = _View(
             dict(joined.columns),
-            np.arange(joined.nrows, dtype=np.int64),
+            jnp.arange(joined.nrows, dtype=jnp.int32),
             joined.device,
             joined.nrows,
         )
@@ -188,14 +199,22 @@ def _exec_stage(view: "_View", node: P.PlanNode) -> "_View":
         if dev_index is None or not dev_index.supported:
             raise UnsupportedPlan("except build side has no packed device index")
         _check_key_cells(view, node.columns)
-        stream = view.materialize()
+        # the anti-join mask needs only the KEY columns: gather just
+        # those instead of materializing the whole (possibly wide) view
+        key_view = _View(
+            {c: view.cols[c] for c in node.columns if c in view.cols},
+            view.sel,
+            view.device,
+            view.full_len,
+        )
+        stream = key_view.materialize()
         try:
             keep = J.except_mask(stream, dev_index, list(node.columns))
         except MissingColumnError as e:  # backstop; _check_key_cells covers it
             raise DataSourceError(0, e) from e
         # except_ passes rows through 1:1, so keep the original row space
         # (and its scan_base numbering): just narrow the selection
-        view.sel = view.sel[np.asarray(keep, dtype=bool)]
+        view.sel = view.sel[jnp.asarray(keep)]
     else:
         raise UnsupportedPlan(f"no device lowering for {type(node).__name__}")
 
@@ -225,17 +244,20 @@ def first_missing_cell(view: _View, columns):
     order.  Returns ``(source row number, column)`` (numbered by the
     originating source, ``scan_base + original row id``) or None.
     """
+    import jax.numpy as jnp
+
     best = None  # (streamed position, column)
     for c in columns:
         col = view.cols.get(c)
         if col is None:
             pos = 0  # missing from the schema: every streamed row lacks it
         elif col.has_absent:
-            codes = np.asarray(col.codes)[view.sel]
-            bad = np.flatnonzero(codes < 0)
-            if not bad.size:
+            # error path: syncing scalars here is fine (the pipeline is
+            # about to abort with this row number)
+            bad = jnp.take(col.codes, view.sel, axis=0) < 0
+            if not bool(jnp.any(bad)):
                 continue
-            pos = int(bad[0])
+            pos = int(jnp.argmax(bad))
         else:
             continue
         if best is None or pos < best[0]:

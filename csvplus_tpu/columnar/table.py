@@ -143,11 +143,20 @@ class StringColumn:
     def __len__(self) -> int:
         return int(self.codes.shape[0])
 
-    def gather(self, sel) -> "StringColumn":
-        """New column of the selected row positions (device gather)."""
+    def gather(self, sel, codes=None) -> "StringColumn":
+        """New column of the selected row positions (device gather).
+
+        *codes* substitutes a differently-placed copy of this column's
+        codes (e.g. replicated onto the probe's mesh) — the dictionary
+        and caches still come from self."""
+        src = self.codes if codes is None else codes
         idx = jnp.asarray(sel, dtype=jnp.int32)
-        out = StringColumn(self.dictionary, jnp.take(self.codes, idx, axis=0))
+        out = StringColumn(self.dictionary, jnp.take(src, idx, axis=0))
         out._str_dict = self._str_dict  # dictionary unchanged; keep cache
+        if self._has_absent is False:
+            # a subset of a fully-present column is fully present; keeps
+            # downstream has_absent checks at zero device work
+            out._has_absent = False
         return out
 
     def decode(self) -> List[Optional[str]]:
@@ -195,8 +204,7 @@ def merge_with_fallback(primary: StringColumn, fallback: StringColumn) -> String
     stream row *without* the cell keeps the index (fallback) value.
     Both columns are recoded into the union dictionary first.
     """
-    p_codes = np.asarray(primary.codes)
-    if not (p_codes < 0).any():
+    if not primary.has_absent:  # one cached scalar sync, no O(n) transfer
         return primary
     union = np.union1d(primary.dictionary, fallback.dictionary)
     p = primary.renumbered_to(union)

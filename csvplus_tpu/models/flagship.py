@@ -148,23 +148,27 @@ class ThreewayJoin:
             n_out = self.n_orders
         else:
             # compaction path (unmatched rows or padded/sharded stream):
-            # resolve the data-dependent selection on host, where mixing
-            # sharded and unsharded operands is a non-issue; one upload
-            # per output column puts the compacted result back on device
-            valid_np = np.asarray(valid)
-            sel = np.flatnonzero(valid_np)
-            ids_c = np.asarray(lo_c)[sel]
-            ids_p = np.asarray(lo_p)[sel]
+            # device mask -> compacted selection (only its SIZE syncs to
+            # host), then device gathers; sharded probe results are
+            # resharded device-to-device onto each build side's device,
+            # so no row data ever round-trips through host numpy
+            sel = jnp.flatnonzero(valid)
+            ids_c = jnp.take(lo_c, sel, axis=0)
+            ids_p = jnp.take(lo_p, sel, axis=0)
+            dev_c = self.cust.table.device
+            dev_p = self.prod.table.device
+            ids_c = jax.device_put(ids_c, dev_c)
+            ids_p = jax.device_put(ids_p, dev_p)
             g_c = tuple(
-                jnp.asarray(np.asarray(self.cust.table.columns[n].codes)[ids_c])
+                jnp.take(self.cust.table.columns[n].codes, ids_c, axis=0)
                 for n in names_c
             )
             g_p = tuple(
-                jnp.asarray(np.asarray(self.prod.table.columns[n].codes)[ids_p])
+                jnp.take(self.prod.table.columns[n].codes, ids_p, axis=0)
                 for n in names_p
             )
             g_o = tuple(
-                jnp.asarray(np.asarray(self.orders_cols[n].codes)[sel])
+                jnp.take(self.orders_cols[n].codes, sel, axis=0)
                 for n in names_o
             )
             n_out = int(sel.shape[0])

@@ -15,9 +15,11 @@ The reference's join is a per-row binary search over sorted string rows
   single vectorized ``searchsorted`` finds every row's match range at
   once — one fused device pass instead of ``n`` host binary searches;
 * match fan-out (non-unique indices) is data-dependent, so expansion is
-  two-phase: counts are computed on device, the total synced to host,
-  and the gather index vectors built with numpy before the final device
-  gathers — the count -> prefix-sum -> scatter pattern from SURVEY.md §7.
+  two-phase: counts are computed on device, ONLY the total match count is
+  synced to host (it sizes the static output shape), and the gather
+  index vectors are built by a jitted prefix-sum + searchsorted kernel
+  on device — the count -> prefix-sum -> scatter pattern from
+  SURVEY.md §7 with O(1) host transfer.
 
 Key-width tiers (TPUs are 32-bit-native; JAX int64 needs global x64):
 
@@ -195,8 +197,12 @@ class DeviceIndex:
 
     def probe(
         self, probe_cols: List[StringColumn], nrows: int
-    ) -> Tuple[np.ndarray, np.ndarray]:
-        """(lower, counts) per probe row, as host arrays.
+    ) -> "Tuple[jax.Array, jax.Array] | Tuple[np.ndarray, np.ndarray]":
+        """(lower, counts) per probe row.
+
+        The narrow-key (int32) tier answers with DEVICE arrays so the
+        fan-out expansion and gathers consume them without a host sync;
+        the wide-key and partitioned tiers answer in host numpy.
 
         Fewer probe columns than key columns = a prefix probe matching the
         whole key range under the prefix.
@@ -238,8 +244,9 @@ class DeviceIndex:
                 return lower, counts
 
             keys = self._keys_for(qk)
-            lower, counts = _probe_kernel_i32(keys, qk, jnp.int32(1) << range_shift)
-            return np.asarray(lower), np.asarray(counts)
+            # stays on device: fan-out expansion and gathers consume these
+            # directly, so no O(n) host sync happens in the probe
+            return _probe_kernel_i32(keys, qk, jnp.int32(1) << range_shift)
 
         # wide keys: pack + search on host (numpy int64)
         qk64 = np.zeros(nrows, dtype=np.int64)
@@ -259,10 +266,8 @@ class DeviceIndex:
 def expand_matches(
     lower: np.ndarray, counts: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Fan-out expansion: (probe row ids, build row ids) for every match.
-
-    count -> exclusive prefix sum -> per-match offsets; numpy on host
-    because the total is data-dependent (it was just synced anyway).
+    """Fan-out expansion on host (wide-key/partitioned tiers, whose
+    probe answers are numpy): (probe row ids, build row ids) per match.
     """
     total = int(counts.sum())
     probe_ids = np.repeat(np.arange(counts.shape[0], dtype=np.int64), counts)
@@ -275,6 +280,44 @@ def expand_matches(
     return probe_ids, build_ids
 
 
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("padded_total",))
+def _expand_kernel(lower, counts, padded_total: int):
+    """Device fan-out expansion with a static output size: exclusive
+    prefix sum over counts locates each probe row's output segment, a
+    vectorized searchsorted inverts it per output slot.  Positions past
+    the true total produce clipped junk the caller slices off."""
+    counts = counts.astype(jnp.int32)
+    ends = jnp.cumsum(counts)
+    out_pos = jnp.arange(padded_total, dtype=jnp.int32)
+    probe_ids = jnp.searchsorted(ends, out_pos, side="right").astype(jnp.int32)
+    probe_ids = jnp.minimum(probe_ids, counts.shape[0] - 1)
+    group_base = jnp.take(ends - counts, probe_ids, axis=0)
+    build_ids = jnp.take(lower.astype(jnp.int32), probe_ids, axis=0) + (
+        out_pos - group_base
+    )
+    return probe_ids, build_ids
+
+
+def expand_matches_device(lower, counts) -> Tuple[jax.Array, jax.Array]:
+    """Fan-out expansion on device; only the total (sizing the static
+    output shape) crosses to host — SURVEY §7's count -> prefix-sum ->
+    scatter.  The kernel compiles at the next power of two, so repeated
+    joins with varying totals hit O(log n) distinct shapes, not one
+    compilation per total."""
+    if counts.shape[0] == 0:  # empty probe: nothing to expand
+        empty = jnp.zeros(0, dtype=jnp.int32)
+        return empty, empty
+    total = int(jnp.sum(counts))  # the one O(1) sync
+    padded = 1 << max(total - 1, 0).bit_length()
+    probe_ids, build_ids = _expand_kernel(
+        jnp.asarray(lower), jnp.asarray(counts), padded
+    )
+    return probe_ids[:total], build_ids[:total]
+
+
 def _checked_probe_cols(
     stream: DeviceTable, columns: Sequence[str]
 ) -> List[StringColumn]:
@@ -283,7 +326,9 @@ def _checked_probe_cols(
     The host path raises ``missing column`` — wrapped with the row number —
     either when the column is absent from the whole stream or when an
     individual (heterogeneous) row lacks the cell (csvplus.go:556,599 via
-    SelectValues).  Columnar absent cells are code -1.
+    SelectValues).  Columnar absent cells are code -1.  The presence
+    check is one cached scalar per column (``has_absent``); the O(n)
+    scan happens only on the error path.
     """
     from ..errors import DataSourceError
     from ..row import MissingColumnError
@@ -293,12 +338,37 @@ def _checked_probe_cols(
         if c not in stream.columns:
             raise MissingColumnError(c)
         col = stream.columns[c]
-        codes = np.asarray(col.codes)
-        absent = np.flatnonzero(codes < 0)
-        if absent.size:
-            raise DataSourceError(int(absent[0]), MissingColumnError(c))
+        if col.has_absent:
+            bad = jnp.asarray(col.codes) < 0
+            raise DataSourceError(int(jnp.argmax(bad)), MissingColumnError(c))
         out.append(col)
     return out
+
+
+def _aligned_codes(dev_index: "DeviceIndex", name: str, codes, ids):
+    """Build-side codes placed compatibly with the gather ids' devices.
+
+    A mesh-sharded probe produces mesh-committed ids; the (small) build
+    side is replicated onto that mesh — the broadcast-join layout — and
+    cached per device set on the index, like ``_keys_for``.
+    """
+    ids_sh = getattr(ids, "sharding", None)
+    codes_sh = getattr(codes, "sharding", None)
+    if ids_sh is None or codes_sh is None:
+        return codes
+    if codes_sh.device_set == ids_sh.device_set or len(ids_sh.device_set) <= 1:
+        return codes
+    cache = getattr(dev_index, "_attr_repl_cache", None)
+    if cache is None:
+        cache = dev_index._attr_repl_cache = {}
+    hit = cache.get(name)
+    if hit is not None and hit[0] == ids_sh.device_set:
+        return hit[1]
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = jax.device_put(codes, NamedSharding(ids_sh.mesh, P()))
+    cache[name] = (ids_sh.device_set, repl)
+    return repl
 
 
 def join_tables(
@@ -323,11 +393,15 @@ def join_tables(
 
     probe_cols = _checked_probe_cols(stream, columns)
     lower, counts = dev_index.probe(probe_cols, stream.nrows)
-    probe_ids, build_ids = expand_matches(lower, counts)
+    if isinstance(lower, jax.Array):
+        probe_ids, build_ids = expand_matches_device(lower, counts)
+    else:  # wide-key / partitioned tiers answer in numpy
+        probe_ids, build_ids = expand_matches(lower, counts)
 
     out_cols = {}
     for name, col in dev_index.table.columns.items():
-        out_cols[name] = col.gather(build_ids)
+        aligned = _aligned_codes(dev_index, name, col.codes, build_ids)
+        out_cols[name] = col.gather(build_ids, codes=aligned)
     for name, col in stream.columns.items():  # stream wins on collision...
         g = col.gather(probe_ids)
         if name in out_cols:
@@ -339,8 +413,9 @@ def join_tables(
 
 def except_mask(
     stream: DeviceTable, dev_index: "DeviceIndex", columns: Sequence[str]
-) -> np.ndarray:
-    """Boolean keep-mask for the anti-join (csvplus.go:585-608)."""
+) -> "jax.Array | np.ndarray":
+    """Boolean keep-mask for the anti-join (csvplus.go:585-608); device
+    bool array on the narrow-key tier, numpy on the others."""
     if stream.nrows == 0:
         return np.zeros(0, dtype=bool)
     probe_cols = _checked_probe_cols(stream, columns)

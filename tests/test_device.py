@@ -747,3 +747,76 @@ def test_telemetry_report_format(dev_people):
     assert lines[0].split() == ["stage", "rows", "in", "rows", "out", "time"]
     assert any("Filter" in l and "120" in l and "12" in l for l in lines[1:])
     assert all(l.rstrip().endswith("ms") for l in lines[1:])
+
+
+class _SyncCountingNp:
+    """numpy proxy counting device->host materializations of LARGE jax
+    arrays (np.asarray over >64 elements); scalar syncs stay free."""
+
+    def __init__(self, real):
+        self._real = real
+        self.big_syncs = []
+
+    def __getattr__(self, name):
+        attr = getattr(self._real, name)
+        if name == "asarray":
+            proxy = self
+
+            def counted(x, *a, **k):
+                if isinstance(x, jax.Array) and x.size > 64:
+                    proxy.big_syncs.append(int(x.size))
+                return attr(x, *a, **k)
+
+            return counted
+        return attr
+
+
+def test_pipeline_stages_no_per_row_host_sync(people_csv, orders_csv, monkeypatch):
+    """filter -> join -> select executes with O(1) scalars crossing to
+    host per stage: no stage materializes a row-length array on host
+    (VERDICT round-1 item 2).  The sink decode is outside this scope."""
+    import jax as _jax
+    import csvplus_tpu.columnar.exec as exec_mod
+    import csvplus_tpu.ops.join as join_mod
+    import csvplus_tpu.columnar.table as table_mod
+
+    global jax
+    jax = _jax
+
+    idx = Take(from_file(people_csv)).unique_index_on("id")
+    idx.on_device("cpu")
+    src = (
+        from_file(orders_csv)
+        .on_device("cpu")
+        .filter(Not(Like({"cust_id": "0"})))
+        .join(idx, "cust_id")
+        .select_columns("cust_id", "name", "qty")
+    )
+
+    counters = []
+    for mod in (exec_mod, join_mod, table_mod):
+        proxy = _SyncCountingNp(mod.np)
+        monkeypatch.setattr(mod, "np", proxy)
+        counters.append((mod.__name__, proxy))
+
+    from csvplus_tpu.columnar.exec import execute_plan
+
+    table = execute_plan(src.plan)
+    assert table.nrows > 0
+    # the selection vector itself must be device-resident
+    for mod_name, proxy in counters:
+        assert proxy.big_syncs == [], (
+            f"{mod_name} synced row-length arrays to host: {proxy.big_syncs}"
+        )
+
+
+def test_expand_matches_device_empty():
+    """Empty probe input expands to empty ids, like the numpy twin
+    (review regression)."""
+    import jax.numpy as jnp
+    from csvplus_tpu.ops.join import expand_matches_device
+
+    p, b = expand_matches_device(
+        jnp.zeros(0, dtype=jnp.int32), jnp.zeros(0, dtype=jnp.int32)
+    )
+    assert p.shape == (0,) and b.shape == (0,)
