@@ -26,9 +26,10 @@ Key-width tiers (TPUs are 32-bit-native; JAX int64 needs global x64):
 * <= 31 bits packed — ``int32`` keys, probe fully on device (covers the
   benchmark configs: single join column up to ~1B cardinality, or e.g.
   two columns of 32K x 32K);
-* <= 62 bits — keys packed on host in numpy ``int64``; the dictionary
-  translation gather still runs on device, the binary search runs in
-  numpy's C loop (documented hybrid);
+* <= 62 bits — keys split into TWO nonnegative 31-bit ``int32`` lanes
+  (hi, lo); the probe is a vectorized branchless binary search with a
+  lexicographic two-lane compare, fully on device with no x64 — e.g. a
+  composite key of two 64K-cardinality columns;
 * wider — not packable; the planner falls back to the host join.
 """
 
@@ -49,6 +50,79 @@ from ..columnar.table import DeviceTable, StringColumn
 def _bits_for(n: int) -> int:
     """Bits needed to store codes 0..n-1 plus the sentinel 0 slot."""
     return max(int(n + 1).bit_length(), 1)
+
+
+_MASK31 = (1 << 31) - 1
+
+
+def pack_lanes(codes, shifts, bits):
+    """Pack per-column code arrays into two nonnegative 31-bit int32
+    lanes (hi = key >> 31, lo = key & 0x7FFFFFFF) without 64-bit math:
+    each column's contribution lands in one lane or straddles both.
+    Works on jnp or numpy arrays alike.  Plain signed (hi, lo) compare
+    equals the 62-bit key order because both lanes are nonnegative."""
+    hi = None
+    lo = None
+
+    def _or(acc, v):
+        return v if acc is None else acc | v
+
+    for c, s, b in zip(codes, shifts, bits):
+        c = c.astype(jnp.int32) if isinstance(c, jax.Array) else c.astype(np.int32)
+        if s >= 31:
+            hi = _or(hi, c << (s - 31))
+        elif s + b <= 31:
+            lo = _or(lo, c << s)
+        else:  # straddles the lane boundary
+            k = 31 - s
+            lo = _or(lo, (c & ((1 << k) - 1)) << s)
+            hi = _or(hi, c >> k)
+    zeros = (jnp.zeros_like if isinstance(lo, jax.Array) else np.zeros_like)
+    if hi is None:
+        hi = zeros(lo)
+    if lo is None:
+        lo = zeros(hi)
+    return hi, lo
+
+
+def _searchsorted2(keys_hi, keys_lo, q_hi, q_lo, side: str = "left"):
+    """Vectorized binary search over (hi, lo) lane pairs — branchless,
+    static trip count (runs under jit; n is a trace-time constant from
+    the key shapes).  *side* follows numpy searchsorted semantics."""
+    n = keys_hi.shape[0]
+    lo_idx = jnp.zeros(q_hi.shape, jnp.int32)
+    hi_idx = jnp.full(q_hi.shape, n, jnp.int32)
+    for _ in range(max(int(n).bit_length(), 1)):
+        active = lo_idx < hi_idx
+        mid = (lo_idx + hi_idx) >> 1
+        safe = jnp.clip(mid, 0, max(n - 1, 0))
+        kh = jnp.take(keys_hi, safe, axis=0)
+        kl = jnp.take(keys_lo, safe, axis=0)
+        if side == "left":
+            descend = (kh < q_hi) | ((kh == q_hi) & (kl < q_lo))
+        else:
+            descend = (kh < q_hi) | ((kh == q_hi) & (kl <= q_lo))
+        lo_idx = jnp.where(active & descend, mid + 1, lo_idx)
+        hi_idx = jnp.where(active & ~descend, mid, hi_idx)
+    return lo_idx
+
+
+@jax.jit
+def _probe_kernel_i32pair(keys_hi, keys_lo, q_hi, q_lo, r_hi, r_lo, ok):
+    """Wide-key range probe: two lane-pair binary searches (lower at the
+    query, upper at query + range with a 31-bit carry)."""
+    n = keys_hi.shape[0]
+    lower = _searchsorted2(keys_hi, keys_lo, q_hi, q_lo)
+    lo2 = q_lo + r_lo
+    # two 31-bit values can sum to 2^31, wrapping int32 negative; the
+    # carry must be the unsigned bit 31, not the arithmetic sign fill
+    carry = (lo2 >> 31) & 1
+    lo2 = lo2 & _MASK31
+    hi2 = q_hi + r_hi + carry
+    upper = _searchsorted2(keys_hi, keys_lo, hi2, lo2)
+    upper = jnp.where(hi2 < 0, n, upper)  # range walked off the 62-bit top
+    counts = jnp.where(ok, upper - lower, 0)
+    return lower.astype(jnp.int32), counts.astype(jnp.int32)
 
 
 @jax.jit
@@ -77,6 +151,9 @@ class DeviceIndex:
     packed_i32: Optional[jax.Array]  # int32[n] sorted, device (narrow keys)
     packed_i64: Optional[np.ndarray]  # int64[n] sorted, host (wide keys)
     shifts: Optional[List[int]]  # bit offset per key column
+    bits: Optional[List[int]] = None  # bit width per key column
+    packed_hi: Optional[jax.Array] = None  # wide keys: 31-bit hi lane, device
+    packed_lo: Optional[jax.Array] = None  # wide keys: 31-bit lo lane, device
 
     # Build sides with at least this many keys probe via the range-
     # partitioned lax.all_to_all path (parallel/pjoin.py) instead of
@@ -105,12 +182,15 @@ class DeviceIndex:
             key = jnp.zeros(table.nrows, dtype=jnp.int32)
             for c, s in zip(cols, shifts):
                 key = key | (c.codes.astype(jnp.int32) << s)
-            return cls(table, key_columns, key, None, shifts)
+            return cls(table, key_columns, key, None, shifts, bits)
 
+        # wide keys: dual 31-bit int32 lanes on device; the host int64
+        # copy serves point_bounds and the partitioned-path preparation
+        hi, lo = pack_lanes([c.codes for c in cols], shifts, bits)
         key64 = np.zeros(table.nrows, dtype=np.int64)
         for c, s in zip(cols, shifts):
             key64 |= np.asarray(c.codes).astype(np.int64) << s
-        return cls(table, key_columns, None, key64, shifts)
+        return cls(table, key_columns, None, key64, shifts, bits, hi, lo)
 
     @property
     def supported(self) -> bool:
@@ -161,31 +241,43 @@ class DeviceIndex:
             return cached[1]
         from ..parallel.pjoin import prepare_partitioned
 
-        prepared = prepare_partitioned(
-            qk_sh.mesh, np.asarray(self.packed_i32)
+        keys = (
+            np.asarray(self.packed_i32)
+            if self.packed_i32 is not None
+            else self.packed_i64
         )
+        prepared = prepare_partitioned(qk_sh.mesh, keys)
         self._part_cache = (qk_sh.device_set, prepared)
         return prepared
 
     def _keys_for(self, qk: jax.Array) -> jax.Array:
-        """The packed key array, replicated onto the probe's mesh when the
-        probe side is row-sharded (broadcast-join layout: the small build
-        side goes everywhere, the probe stays put — no collectives in the
-        probe itself).  The replicated copy is cached per mesh."""
-        keys = self.packed_i32
+        """The packed int32 key array, replicated onto the probe's mesh
+        when the probe side is row-sharded (broadcast-join layout: the
+        small build side goes everywhere, the probe stays put — no
+        collectives in the probe itself)."""
+        return self._lanes_for(qk, "packed_i32")
+
+    def _lanes_for(self, qk: jax.Array, attr: str) -> jax.Array:
+        """A packed key array (``packed_i32``/``packed_hi``/``packed_lo``),
+        replicated onto the probe's mesh when the probe is row-sharded;
+        the replicated copy is cached per (attribute, device set)."""
+        keys = getattr(self, attr)
         qk_sh = getattr(qk, "sharding", None)
         if qk_sh is None or len(qk_sh.device_set) <= 1:
             return keys
         keys_sh = getattr(keys, "sharding", None)
         if keys_sh is not None and keys_sh.device_set == qk_sh.device_set:
             return keys
-        cached = getattr(self, "_repl_keys", None)
-        if cached is not None and cached[0] == qk_sh.device_set:
-            return cached[1]
+        cache = getattr(self, "_lane_repl", None)
+        if cache is None:
+            cache = self._lane_repl = {}
+        hit = cache.get(attr)
+        if hit is not None and hit[0] == qk_sh.device_set:
+            return hit[1]
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         repl = jax.device_put(keys, NamedSharding(qk_sh.mesh, P()))
-        self._repl_keys = (qk_sh.device_set, repl)
+        cache[attr] = (qk_sh.device_set, repl)
         return repl
 
     def _translated(self, probe_cols: List[StringColumn], n_key_cols: int):
@@ -200,9 +292,11 @@ class DeviceIndex:
     ) -> "Tuple[jax.Array, jax.Array] | Tuple[np.ndarray, np.ndarray]":
         """(lower, counts) per probe row.
 
-        The narrow-key (int32) tier answers with DEVICE arrays so the
-        fan-out expansion and gathers consume them without a host sync;
-        the wide-key and partitioned tiers answer in host numpy.
+        Both single-device tiers (narrow int32 and wide dual-lane)
+        answer with DEVICE arrays so the fan-out expansion and gathers
+        consume them without a host sync; only the partitioned
+        (multi-chip) tier answers in host numpy — its exchange wrapper
+        is host-orchestrated (padding, capacity retry, hot keys).
 
         Fewer probe columns than key columns = a prefix probe matching the
         whole key range under the prefix.
@@ -248,27 +342,57 @@ class DeviceIndex:
             # directly, so no O(n) host sync happens in the probe
             return _probe_kernel_i32(keys, qk, jnp.int32(1) << range_shift)
 
-        # wide keys: pack + search on host (numpy int64)
-        qk64 = np.zeros(nrows, dtype=np.int64)
-        ok = np.ones(nrows, dtype=bool)
-        for c, s in zip(codes, self.shifts):
-            cn = np.asarray(c).astype(np.int64)
-            ok &= cn >= 0
-            qk64 |= np.where(cn >= 0, cn, 0) << s
-        lower = np.searchsorted(self.packed_i64, qk64, side="left")
-        upper = np.searchsorted(
-            self.packed_i64, qk64 + (np.int64(1) << range_shift), side="left"
+        # wide keys: dual 31-bit lane probe, fully on device (no x64)
+        ok = jnp.ones(nrows, dtype=bool)
+        clamped = []
+        for c in codes:
+            ok = ok & (c >= 0)
+            clamped.append(jnp.where(c >= 0, c, 0))
+        q_hi, q_lo = pack_lanes(clamped, self.shifts, self.bits)
+
+        # large build sides probed by a mesh-sharded stream go through
+        # the partitioned all_to_all path, same policy as the i32 tier
+        qk_sh = getattr(q_hi, "sharding", None)
+        if (
+            k == len(self.key_columns)
+            and int(self.packed_i64.shape[0]) >= self.PARTITION_MIN_KEYS
+            and qk_sh is not None
+            and len(qk_sh.device_set) > 1
+            and hasattr(qk_sh, "mesh")
+        ):
+            from ..parallel.pjoin import partitioned_probe
+
+            # the partitioned wrapper is host-orchestrated (padding,
+            # capacity retry, hot-key sampling), so the probe keys sync
+            # once here — two int32 lanes, the same bytes as one int64
+            qk64 = (np.asarray(q_hi).astype(np.int64) << 31) | np.asarray(q_lo)
+            qk64 = np.where(np.asarray(ok), qk64, np.int64(-1))
+            return partitioned_probe(
+                qk_sh.mesh,
+                qk64,
+                self.packed_i64,
+                prepared=self._partitioned_for(qk_sh),
+            )
+
+        range_size = 1 << range_shift
+        keys_hi = self._lanes_for(q_hi, "packed_hi")
+        keys_lo = self._lanes_for(q_hi, "packed_lo")
+        return _probe_kernel_i32pair(
+            keys_hi,
+            keys_lo,
+            q_hi,
+            q_lo,
+            jnp.int32(range_size >> 31),
+            jnp.int32(range_size & _MASK31),
+            ok,
         )
-        counts = np.where(ok, upper - lower, 0)
-        return lower.astype(np.int64), counts.astype(np.int64)
 
 
 def expand_matches(
     lower: np.ndarray, counts: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Fan-out expansion on host (wide-key/partitioned tiers, whose
-    probe answers are numpy): (probe row ids, build row ids) per match.
-    """
+    """Fan-out expansion on host (the partitioned tier, whose probe
+    answers are numpy): (probe row ids, build row ids) per match."""
     total = int(counts.sum())
     probe_ids = np.repeat(np.arange(counts.shape[0], dtype=np.int64), counts)
     starts = np.repeat(lower.astype(np.int64), counts)
@@ -395,7 +519,7 @@ def join_tables(
     lower, counts = dev_index.probe(probe_cols, stream.nrows)
     if isinstance(lower, jax.Array):
         probe_ids, build_ids = expand_matches_device(lower, counts)
-    else:  # wide-key / partitioned tiers answer in numpy
+    else:  # the partitioned (multi-chip) tier answers in numpy
         probe_ids, build_ids = expand_matches(lower, counts)
 
     out_cols = {}

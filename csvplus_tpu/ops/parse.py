@@ -10,13 +10,13 @@ more than moving every op to the device):
   a round-trip plus a per-file-size XLA compile and nothing else;
 * the byte buffer uploads once (pow2-bucketed so downstream kernels
   compile a bounded executable set) and **dictionary encoding — the
-  heavy part — happens on device**: fields (<= 8 bytes) are
-  gathered into NUL-padded byte matrices and packed big-endian into two
-  int32 lanes (sign-flipped so signed compare == byte order), a two-key
-  stable ``lax.sort`` groups equal fields, run boundaries become dense
-  ranks via a cumulative sum, and a scatter returns codes in row order.
-  Only the (few) unique values are ever touched by the host, to build
-  the sorted string dictionary.
+  heavy part — happens on device**: fields (<= 32 bytes) are
+  gathered into NUL-padded byte matrices and packed big-endian into
+  2/4/8 int32 lanes (sign-flipped so signed compare == byte order), a
+  multi-key stable ``lax.sort`` groups equal fields, run boundaries
+  become dense ranks via a cumulative sum, and a scatter returns codes
+  in row order.  Only the (few) unique values are ever touched by the
+  host, to build the sorted string dictionary.
 
 Scope (the honest fast path, per SURVEY's strategy): simple rectangular
 CSV — no quotes, no comment lines, no blank interior lines, no CR — the
@@ -71,38 +71,47 @@ def _offsets_np(host_arr: np.ndarray, delim_byte: int, trailing_nl: bool):
     return starts, ends, rec_counts.astype(np.int32)
 
 
-@jax.jit
-def _encode_column_kernel(data, starts, lens):
-    """Device dictionary-encode one column of fields (<= 8 bytes each).
+from functools import partial as _partial
 
-    Width is fixed at 8 (shorter fields are masked by ``lens``) and the
-    caller buckets the row count, so the jit cache stays tiny.
+
+@_partial(jax.jit, static_argnames=("lanes",))
+def _encode_column_kernel(data, starts, lens, lanes: int = 2):
+    """Device dictionary-encode one column of fields (<= 4*lanes bytes).
+
+    Fields are gathered into NUL-padded byte matrices and packed
+    big-endian into *lanes* sign-flipped int32 lanes, so a multi-key
+    signed sort equals byte-lexicographic order at any width.  *lanes*
+    is static and power-of-two bucketed (2/4/8 -> 8/16/32 bytes), and
+    the caller buckets the row count, so the jit cache stays tiny.
     Returns (codes in row order, number of uniques, first-row-index of
     each unique) — the host decodes only the uniques into the string
     dictionary.
     """
-    width = 8
+    width = 4 * lanes
     m = starts.shape[0]
     idx = starts[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
     mask = jnp.arange(width, dtype=jnp.int32)[None, :] < lens[:, None]
     safe = jnp.clip(idx, 0, data.shape[0] - 1)
     mat = jnp.where(mask, jnp.take(data, safe, axis=0), 0).astype(jnp.int32)
 
-    hi = jnp.zeros(m, dtype=jnp.int32)
-    for b in range(4):
-        hi = hi | (mat[:, b] << (8 * (3 - b)))
-    lo = jnp.zeros(m, dtype=jnp.int32)
-    for b in range(4, 8):
-        lo = lo | (mat[:, b] << (8 * (7 - b)))
-    hi = hi ^ _SIGN  # signed compare now equals byte-lexicographic order
-    lo = lo ^ _SIGN
+    words = []
+    for w in range(lanes):
+        word = jnp.zeros(m, dtype=jnp.int32)
+        for b in range(4):
+            word = word | (mat[:, 4 * w + b] << (8 * (3 - b)))
+        words.append(word ^ _SIGN)  # signed compare == byte order
 
     pos = jnp.arange(m, dtype=jnp.int32)
-    hi_s, lo_s, pos_s = jax.lax.sort((hi, lo, pos), num_keys=2, is_stable=True)
-
-    new_run = jnp.concatenate(
-        [jnp.ones(1, bool), (hi_s[1:] != hi_s[:-1]) | (lo_s[1:] != lo_s[:-1])]
+    sorted_ops = jax.lax.sort(
+        tuple(words) + (pos,), num_keys=lanes, is_stable=True
     )
+    pos_s = sorted_ops[-1]
+
+    neq = None
+    for w_s in sorted_ops[:-1]:
+        d = w_s[1:] != w_s[:-1]
+        neq = d if neq is None else (neq | d)
+    new_run = jnp.concatenate([jnp.ones(1, bool), neq])
     rank = jnp.cumsum(new_run) - 1  # dense code per sorted position
     codes = jnp.zeros(m, dtype=jnp.int32).at[pos_s].set(rank.astype(jnp.int32))
     n_uniq = rank[-1] + 1 if m else jnp.int32(0)
@@ -173,7 +182,7 @@ def _bucket_len(n: int) -> int:
     return b
 
 
-_DEVICE_ENCODE_MAX_LEN = 8
+_DEVICE_ENCODE_MAX_LEN = 32  # 8 int32 lanes
 
 
 def encode_column_device(
@@ -182,15 +191,21 @@ def encode_column_device(
     starts: np.ndarray,
     lens: np.ndarray,
 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-    """Fully-device dictionary encode of one column (fields <= 8 bytes).
+    """Fully-device dictionary encode of one column (fields <= 32 bytes,
+    packed into 2/4/8 int32 lanes by the column's widest field).
 
     Returns (sorted bytes dictionary, int32 codes) matching
     encode_strings' contract, or None for wider fields.
     """
     if starts.shape[0] == 0:
         return np.empty(0, dtype="S1"), np.empty(0, dtype=np.int32)
-    if int(lens.max()) > _DEVICE_ENCODE_MAX_LEN:
+    max_len = int(lens.max())
+    if max_len > _DEVICE_ENCODE_MAX_LEN:
         return None
+    # lanes bucketed to powers of two: 8-, 16- or 32-byte kernel variants
+    lanes = 2
+    while 4 * lanes < max_len:
+        lanes *= 2
     # bucket the row count (pow2, floor 2048) so the jitted kernel
     # compiles O(log n) executables total; pad entries duplicate field 0,
     # which cannot change the dictionary or the real rows' codes
@@ -203,6 +218,7 @@ def encode_column_device(
         data_dev,
         jnp.asarray(starts, dtype=jnp.int32),
         jnp.asarray(lens, dtype=jnp.int32),
+        lanes=lanes,
     )
     codes = codes[:m]
     k = int(n_uniq)

@@ -64,21 +64,46 @@ def _flat_axis_index(axes: "tuple[str, ...]"):
     return idx
 
 
+# 62-bit sentinel for wide (int64) keys: packed keys keep headroom below
+# it (DeviceIndex._bits_for reserves a slot above every code range)
+_SENT62 = np.int64((1 << 62) - 1)
+
+
+def _sentinel_for(dtype) -> "np.int32 | np.int64":
+    return _SENT62 if np.dtype(dtype) == np.int64 else _SENTINEL
+
+
+def split_lanes(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """int64 keys -> two nonnegative 31-bit int32 lanes; -1 -> (-1, -1).
+
+    The 62-bit sentinel maps to (MASK31, MASK31), still the maximum in
+    lane order."""
+    hi = (x >> 31).astype(np.int32)
+    lo = (x & np.int64((1 << 31) - 1)).astype(np.int32)
+    neg = x < 0
+    if neg.any():
+        hi = np.where(neg, np.int32(-1), hi)
+        lo = np.where(neg, np.int32(-1), lo)
+    return hi, lo
+
+
 def partition_sorted_keys(
     keys: np.ndarray, n_shards: int
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Range-partition a sorted int32 key array into equal padded slices.
+    """Range-partition a sorted key array (int32 or int64) into equal
+    padded slices.
 
-    Returns (local_keys[(N, k)] padded with SENTINEL, splits[(N,)] =
-    first key per shard, base[(N,)] = global row offset per shard).
-    Slice boundaries are snapped to run starts so one key never spans
-    two shards.
+    Returns (local_keys[(N, k)] padded with the dtype's sentinel,
+    splits[(N,)] = first key per shard, base[(N,)] = global row offset
+    per shard).  Slice boundaries are snapped to run starts so one key
+    never spans two shards.
     """
+    sent = _sentinel_for(keys.dtype)
     n = keys.shape[0]
     if n == 0:
         return (
-            np.full((n_shards, 1), _SENTINEL, dtype=np.int32),
-            np.full(n_shards, _SENTINEL, dtype=np.int32),
+            np.full((n_shards, 1), sent, dtype=keys.dtype),
+            np.full(n_shards, sent, dtype=keys.dtype),
             np.zeros(n_shards, dtype=np.int32),
         )
     starts = np.flatnonzero(np.concatenate([[True], keys[1:] != keys[:-1]]))
@@ -92,14 +117,14 @@ def partition_sorted_keys(
     ends = np.append(bounds[1:], n)
     sizes = ends - bounds
     k = max(int(sizes.max()), 1)
-    local = np.full((n_shards, k), _SENTINEL, dtype=np.int32)
+    local = np.full((n_shards, k), sent, dtype=keys.dtype)
     for s in range(n_shards):
         local[s, : sizes[s]] = keys[bounds[s] : ends[s]]
     # splits must be non-decreasing for the routing binary search: an empty
     # shard inherits the NEXT non-empty shard's first key, so equal splits
     # route (via side='right') to the right-most shard — the actual owner.
-    splits = np.full(n_shards, _SENTINEL, dtype=np.int32)
-    nxt = _SENTINEL
+    splits = np.full(n_shards, sent, dtype=keys.dtype)
+    nxt = sent
     for s in range(n_shards - 1, -1, -1):
         if sizes[s] > 0:
             nxt = local[s, 0]
@@ -172,6 +197,96 @@ def _probe_shard_kernel(n_shards: int, capacity: int, axes, qk, keys_local, spli
     return out_lo, out_ct
 
 
+def _probe_shard_kernel2(
+    n_shards: int,
+    capacity: int,
+    axes,
+    qh,
+    ql,
+    keys_hi,
+    keys_lo,
+    splits_hi,
+    splits_lo,
+    base,
+):
+    """Dual-lane (62-bit key) variant of :func:`_probe_shard_kernel`:
+    identical routing/exchange structure, with the key carried as two
+    nonnegative 31-bit int32 lanes and every comparison lexicographic
+    over (hi, lo).  Costs one extra (N, C) exchange for the second lane.
+    """
+    from ..ops.join import _searchsorted2
+
+    m = qh.shape[0]
+    N, C = n_shards, capacity
+
+    valid = qh >= 0
+    dest = jnp.clip(
+        _searchsorted2(splits_hi, splits_lo, qh, ql, side="right") - 1, 0, N - 1
+    )
+    dest = jnp.where(valid, dest, N).astype(jnp.int32)
+
+    pos = jnp.arange(m, dtype=jnp.int32)
+    dest_s, qh_s, ql_s, pos_s = lax.sort(
+        (dest, qh, ql, pos), num_keys=1, is_stable=True
+    )
+    routed = dest_s < N
+
+    group_start = jnp.searchsorted(
+        dest_s, jnp.arange(N + 1, dtype=jnp.int32), side="left"
+    )
+    rank = jnp.arange(m, dtype=jnp.int32) - group_start[dest_s]
+    ok = routed & (rank < C)
+    safe_dest = jnp.minimum(dest_s, N - 1)
+
+    slot = jnp.where(ok, rank, C)
+    buf_h = jnp.full((N, C), -1, jnp.int32).at[safe_dest, slot].set(qh_s, mode="drop")
+    buf_l = jnp.full((N, C), -1, jnp.int32).at[safe_dest, slot].set(ql_s, mode="drop")
+
+    recv_h = lax.all_to_all(buf_h, axes, split_axis=0, concat_axis=0, tiled=True)
+    recv_l = lax.all_to_all(buf_l, axes, split_axis=0, concat_axis=0, tiled=True)
+
+    q_h = recv_h.reshape(-1)
+    q_l = recv_l.reshape(-1)
+    lo = _searchsorted2(keys_hi, keys_lo, q_h, q_l, side="left")
+    hi = _searchsorted2(keys_hi, keys_lo, q_h, q_l, side="right")
+    found = (hi > lo) & (q_h >= 0)
+    my_base = base[_flat_axis_index(axes)]
+    resp_lo = jnp.where(found, lo.astype(jnp.int32) + my_base, -1)
+    resp_ct = jnp.where(found, (hi - lo).astype(jnp.int32), 0)
+
+    back_lo = lax.all_to_all(
+        resp_lo.reshape(N, C), axes, split_axis=0, concat_axis=0, tiled=True
+    )
+    back_ct = lax.all_to_all(
+        resp_ct.reshape(N, C), axes, split_axis=0, concat_axis=0, tiled=True
+    )
+
+    safe_rank = jnp.clip(rank, 0, C - 1)
+    got_lo = jnp.where(ok, back_lo[safe_dest, safe_rank], -1)
+    got_ct = jnp.where(
+        routed, jnp.where(ok, back_ct[safe_dest, safe_rank], -1), 0
+    )
+
+    out_lo = jnp.zeros(m, jnp.int32).at[pos_s].set(got_lo)
+    out_ct = jnp.zeros(m, jnp.int32).at[pos_s].set(got_ct)
+    return out_lo, out_ct
+
+
+@partial(jax.jit, static_argnames=("mesh", "n_shards", "capacity"))
+def _probe_spmd2(
+    mesh, n_shards, capacity, qh, ql, keys_hi, keys_lo, splits_hi, splits_lo, base
+):
+    axes = tuple(mesh.axis_names)
+    rows = P(axes)
+    f = shard_map(
+        partial(_probe_shard_kernel2, n_shards, capacity, axes),
+        mesh=mesh,
+        in_specs=(rows, rows, rows, rows, P(), P(), P()),
+        out_specs=(rows, rows),
+    )
+    return f(qh, ql, keys_hi, keys_lo, splits_hi, splits_lo, base)
+
+
 @partial(jax.jit, static_argnames=("mesh", "n_shards", "capacity"))
 def _probe_spmd(mesh, n_shards, capacity, qk_sharded, keys_local, splits, base):
     axes = tuple(mesh.axis_names)
@@ -187,15 +302,32 @@ def _probe_spmd(mesh, n_shards, capacity, qk_sharded, keys_local, splits, base):
 
 def prepare_partitioned(mesh: Mesh, index_keys_sorted: np.ndarray):
     """Range-partition + upload the build keys once; reusable across
-    probes (see DeviceIndex._partitioned_for's cache)."""
+    probes (see DeviceIndex._partitioned_for's cache).
+
+    int32 keys -> a 3-tuple (keys, splits, base); int64 (wide, 62-bit)
+    keys -> a 5-tuple of dual 31-bit lanes (keys_hi, keys_lo, splits_hi,
+    splits_lo, base)."""
     n_shards = mesh.devices.size
+    rows = NamedSharding(mesh, row_spec(mesh))
+    repl = NamedSharding(mesh, P())
+    if np.dtype(index_keys_sorted.dtype) == np.int64:
+        local, splits, base = partition_sorted_keys(index_keys_sorted, n_shards)
+        lh, ll = split_lanes(local.reshape(-1))
+        sh, sl = split_lanes(splits)
+        return (
+            jax.device_put(lh, rows),
+            jax.device_put(ll, rows),
+            jax.device_put(sh, repl),
+            jax.device_put(sl, repl),
+            jax.device_put(base, repl),
+        )
     local, splits, base = partition_sorted_keys(
         index_keys_sorted.astype(np.int32), n_shards
     )
     return (
-        jax.device_put(local.reshape(-1), NamedSharding(mesh, row_spec(mesh))),
-        jax.device_put(splits, NamedSharding(mesh, P())),
-        jax.device_put(base, NamedSharding(mesh, P())),
+        jax.device_put(local.reshape(-1), rows),
+        jax.device_put(splits, repl),
+        jax.device_put(base, repl),
     )
 
 
@@ -210,17 +342,19 @@ def partitioned_probe(
     ``[lower, lower+count)`` match range in the sorted index key array.
 
     Host-facing wrapper: pads, shards, runs the SPMD kernel, retries on
-    capacity overflow, unpads.  Keys must be int32 packed keys with -1
-    for invalid probes (absent/unmatched dictionary translation).
-    *prepared* short-circuits the partition+upload with the result of
-    :func:`prepare_partitioned`.
+    capacity overflow, unpads.  Keys are packed keys with -1 for invalid
+    probes (absent/unmatched dictionary translation): int32 for narrow
+    (<= 31-bit) keys, int64 for wide (<= 62-bit) keys — the wide tier
+    exchanges dual 31-bit lanes.  *prepared* short-circuits the
+    partition+upload with the result of :func:`prepare_partitioned`.
     """
     n_shards = mesh.devices.size
+    wide = np.dtype(stream_keys.dtype) == np.int64
     if prepared is None:
         prepared = prepare_partitioned(mesh, index_keys_sorted)
-    keys_dev, splits_dev, base_dev = prepared
-
-    stream_keys = stream_keys.astype(np.int32)
+    assert len(prepared) == (5 if wide else 3), "prepared/key dtype mismatch"
+    if not wide:
+        stream_keys = stream_keys.astype(np.int32)
 
     # --- probe-side skew: hot-key short circuit --------------------------
     # A heavy-hitter probe key routes its whole mass to one owner shard
@@ -248,21 +382,37 @@ def partitioned_probe(
                 pos = idx_c[hot_mask]
                 hot_lo = h_lo[pos].astype(np.int32)
                 hot_ct = (h_hi - h_lo)[pos].astype(np.int32)
-                stream_keys = np.where(hot_mask, np.int32(-1), stream_keys)
+                stream_keys = np.where(
+                    hot_mask, stream_keys.dtype.type(-1), stream_keys
+                )
 
-    qk, true_len = pad_to_multiple(stream_keys, n_shards, np.int32(-1))
+    qk, true_len = pad_to_multiple(stream_keys, n_shards, stream_keys.dtype.type(-1))
     m_per_shard = qk.shape[0] // n_shards
     if capacity is None:
         # expect near-uniform routing; retry doubles on skew overflow
         capacity = max(64, 2 * ((m_per_shard + n_shards - 1) // n_shards))
     capacity = 1 << (int(capacity) - 1).bit_length()  # pow2 buckets limit recompiles
 
-    qk_dev = jax.device_put(qk, NamedSharding(mesh, row_spec(mesh)))
+    rows = NamedSharding(mesh, row_spec(mesh))
+    if wide:
+        qh_np, ql_np = split_lanes(qk)
+        qh_dev = jax.device_put(qh_np, rows)
+        ql_dev = jax.device_put(ql_np, rows)
+        kh_dev, kl_dev, sh_dev, sl_dev, base_dev = prepared
+    else:
+        qk_dev = jax.device_put(qk, rows)
+        keys_dev, splits_dev, base_dev = prepared
 
     while True:
-        lo, ct = _probe_spmd(
-            mesh, n_shards, capacity, qk_dev, keys_dev, splits_dev, base_dev
-        )
+        if wide:
+            lo, ct = _probe_spmd2(
+                mesh, n_shards, capacity,
+                qh_dev, ql_dev, kh_dev, kl_dev, sh_dev, sl_dev, base_dev,
+            )
+        else:
+            lo, ct = _probe_spmd(
+                mesh, n_shards, capacity, qk_dev, keys_dev, splits_dev, base_dev
+            )
         ct_np = np.asarray(ct)
         if not (ct_np < 0).any():
             lo_np, ct_np = np.asarray(lo)[:true_len], ct_np[:true_len]

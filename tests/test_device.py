@@ -268,7 +268,7 @@ def test_wide_key_hybrid_path():
     rows = [Row({"a": x, "b": y, "v": z}) for x, y, z in zip(a, b, v)]
     idx = TakeRows(rows).index_on("a", "b")
     idx.on_device("cpu")
-    assert idx.device_table.packed_i64 is not None  # wide tier engaged
+    assert idx.device_table.packed_hi is not None  # wide device tier engaged
 
     probe = DeviceTable.from_pylists(
         {"a": [a[0], a[1], "zzz"], "b": [b[0], "nope", b[2]]}, device="cpu"
@@ -710,7 +710,7 @@ def test_wide_tier_join_seeded_sweep():
                   for _ in range(50)]
         host = TakeRows(probes).join(idx, "a", "b").to_rows()
         idx.on_device("cpu")
-        assert idx.device_table.packed_i64 is not None
+        assert idx.device_table.packed_hi is not None  # wide device tier
         dev = source_from_table(
             DeviceTable.from_rows(probes, device="cpu")
         ).join(idx, "a", "b").to_rows()

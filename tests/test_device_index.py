@@ -251,7 +251,7 @@ def test_wide_tier_point_bounds_find():
     dev_idx = source_from_table(
         DeviceTable.from_pylists({"a": a, "b": b}, device="cpu")
     ).index_on("a", "b")
-    assert dev_idx.device_table.packed_i64 is not None  # wide tier
+    assert dev_idx.device_table.packed_hi is not None  # wide device tier
     assert dev_idx._impl.is_lazy
     probe = a[123]
     assert dev_idx.find(probe).to_rows() == host_idx.find(probe).to_rows()

@@ -353,6 +353,85 @@ def test_unsupported_plan_memoized(people_csv):
         ex.execute_plan = orig
 
 
+def test_wide_key_partitioned_probe_differential(mesh):
+    """int64 (62-bit) packed keys ride the SAME all_to_all exchange via
+    dual 31-bit lanes — differential vs numpy (VERDICT round-1 item 5)."""
+    rng = np.random.default_rng(9)
+    # keys above the 31-bit packed range force the wide tier
+    keys = np.sort(
+        rng.integers(1 << 32, 1 << 40, size=20_000).astype(np.int64)
+    )
+    queries = rng.choice(
+        np.concatenate([keys, rng.integers(1 << 32, 1 << 40, size=5000)]),
+        size=30_001,
+    ).astype(np.int64)
+    queries[::97] = -1  # invalid probes answer (lo=-1, ct=0)
+    lo, ct = partitioned_probe(mesh, queries, keys)
+    olo = np.searchsorted(keys, queries, side="left").astype(np.int32)
+    oct_ = (np.searchsorted(keys, queries, side="right") - olo).astype(np.int32)
+    oct_[queries < 0] = 0
+    assert (ct == oct_).all()
+    hit = ct > 0
+    assert (lo[hit] == olo[hit]).all()
+
+
+def test_wide_composite_key_join_sharded(monkeypatch):
+    """A 2x64K-cardinality composite key (>31-bit packed) joins through
+    the device wide tier AND the partitioned path on a sharded stream,
+    matching the host oracle (VERDICT round-1 item 5's done criterion)."""
+    import csvplus_tpu.ops.join as J
+    import csvplus_tpu.parallel.pjoin as PJ
+    from csvplus_tpu import Row, TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    monkeypatch.setattr(J.DeviceIndex, "PARTITION_MIN_KEYS", 1)
+    calls = {"n": 0}
+    orig = PJ.partitioned_probe
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(PJ, "partitioned_probe", counting)
+
+    rng = np.random.default_rng(13)
+    n = 66_000  # cardinality past 64K so each column needs 17 bits
+    a_vals = [f"a{i:06d}" for i in range(n)]
+    b_vals = [f"b{i:06d}" for i in range(n)]
+    rows = [
+        Row({"a": a_vals[i], "b": b_vals[i], "v": str(i)}) for i in range(n)
+    ]
+    idx = TakeRows(rows).index_on("a", "b")
+    idx.on_device("cpu")
+    assert idx.device_table.packed_hi is not None  # wide tier engaged
+
+    pa = rng.integers(0, n, size=4000)
+    probes = {
+        "a": [a_vals[i] for i in pa],
+        "b": [b_vals[i if i % 3 else (i + 1) % n] for i in pa],
+    }
+    host_rows = [Row({"a": x, "b": y}) for x, y in zip(probes["a"], probes["b"])]
+    host = TakeRows(host_rows).join(idx, "a", "b").to_rows()
+
+    from csvplus_tpu.parallel.mesh import make_mesh
+
+    table = DeviceTable.from_pylists(probes, device="cpu").with_sharding(make_mesh(8))
+    dev = source_from_table(table).join(idx, "a", "b").to_rows()
+    assert dev == host
+    assert calls["n"] >= 1  # the wide partitioned path actually ran
+
+    # carry regression: a PREFIX probe (join on "a" only) whose code has
+    # its low 14 bits all ones (16383) makes the upper-bound lane sum hit
+    # exactly 2^31 — the carry must not sign-fill (review regression)
+    edge = [Row({"a": a_vals[16383]}), Row({"a": a_vals[16384]})]
+    host_edge = TakeRows(edge).join(idx, "a").to_rows()
+    dev_edge = source_from_table(
+        DeviceTable.from_rows(edge, device="cpu")
+    ).join(idx, "a").to_rows()
+    assert dev_edge == host_edge and len(dev_edge) == 2
+
+
 def test_executor_join_partitioned_path(people_csv, orders_csv, monkeypatch):
     """With a low partition threshold and a SHARDED stream, the generic
     executor's join probes via the all_to_all partitioned path — proven
