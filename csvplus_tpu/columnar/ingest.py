@@ -50,6 +50,20 @@ def reader_to_device(
     row_base = 2 if reader._header_from_first_row else 1
 
     path = getattr(reader, "_path", None)
+    if path is not None and _stream_ingest_wanted(path):
+        try:
+            from ..native.scanner import StreamFallback
+        except ImportError:
+            StreamFallback = None
+        if StreamFallback is not None:
+            try:
+                with telemetry.stage("ingest:streamed", 0) as _t:
+                    table = _stream_to_table(reader, path, device)
+                    table.row_base = row_base
+                    _t["rows_out"] = table.nrows
+                return source_from_table(_maybe_shard(table, shards, mesh))
+            except (ImportError, StreamFallback):
+                pass
     if path is not None and _device_parse_enabled():
         try:
             from ..native import scanner as _sc
@@ -96,6 +110,81 @@ def reader_to_device(
         table.row_base = row_base
         _t["rows_out"] = table.nrows
     return source_from_table(_maybe_shard(table, shards, mesh))
+
+
+_STREAM_MIN_BYTES = 256 << 20
+
+
+def _stream_ingest_wanted(path: str) -> bool:
+    """Chunk-streamed ingest engages for files big enough that the
+    whole-file tiers' ``f.read()`` would hurt (default 256MB; tune with
+    CSVPLUS_STREAM_MIN_BYTES, 0 disables)."""
+    import os
+
+    v = os.environ.get("CSVPLUS_STREAM_MIN_BYTES")
+    thresh = int(v) if v else _STREAM_MIN_BYTES
+    if thresh <= 0:
+        return False
+    try:
+        return os.path.getsize(path) >= thresh
+    except OSError:
+        return False
+
+
+def _stream_to_table(reader, path: str, device) -> DeviceTable:
+    """Consume the native streaming chunk generator into one DeviceTable.
+
+    Per chunk, each column's int32 codes are uploaded immediately (the
+    next chunk's host scan overlaps the async transfer) and only the
+    chunk's small sorted dictionary stays on host.  After the last chunk
+    the union dictionary per column is the sorted merge of the chunk
+    dictionaries, and each chunk's codes are remapped to union slots ON
+    DEVICE via a gathered translation table — so host memory stays
+    bounded by one chunk regardless of file size, and code order remains
+    string order (table.py encoding invariant).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..native.scanner import stream_encoded_chunks
+    from .table import default_device
+
+    dev = default_device(device)
+    names = None
+    chunk_dicts: "dict[str, list]" = {}
+    chunk_codes: "dict[str, list]" = {}
+    nrows = 0
+    for cnames, encoded, n in stream_encoded_chunks(reader, path):
+        if names is None:
+            names = cnames
+            chunk_dicts = {c: [] for c in names}
+            chunk_codes = {c: [] for c in names}
+        nrows += n
+        for c in names:
+            d, codes = encoded[c]
+            chunk_dicts[c].append(d)
+            chunk_codes[c].append(jax.device_put(codes, dev))
+    if names is None:  # empty file: defer to the whole-file tiers
+        from ..native.scanner import StreamFallback
+
+        raise StreamFallback("empty file")
+
+    out = {}
+    for c in names:
+        dicts, codes = chunk_dicts[c], chunk_codes[c]
+        if len(dicts) == 1:
+            out[c] = (dicts[0], codes[0])
+            continue
+        width = max(d.dtype.itemsize for d in dicts)
+        dt = np.dtype(f"S{width}")
+        union = np.unique(np.concatenate([d.astype(dt) for d in dicts]))
+        parts = []
+        for d, ck in zip(dicts, codes):
+            mapping = np.searchsorted(union, d.astype(dt)).astype(np.int32)
+            parts.append(jnp.take(jax.device_put(mapping, dev), ck))
+        out[c] = (union, jnp.concatenate(parts))
+    return DeviceTable.from_encoded(out, nrows, device=dev)
 
 
 def _device_parse_enabled() -> bool:

@@ -65,6 +65,37 @@ def gather_columns(ids: jax.Array, valid: jax.Array, *code_arrays: jax.Array):
     return tuple(out)
 
 
+@jax.jit
+def _fused_unique_join(cum_c, cum_p, qk_c, qk_p, cust_codes, prod_codes):
+    """The whole all-matched flagship join as ONE dispatch: two
+    dictionary-direct probes (see ops/join._probe_kernel_direct), the
+    validity reduction, and every build-side attribute gather.  Returns
+    the match count so the caller syncs exactly one scalar."""
+
+    def probe(cum, qk):
+        U = cum.shape[0] - 1
+        q = jnp.clip(qk, 0, U - 1)
+        lo = jnp.take(cum, q, axis=0)
+        cnt = jnp.take(cum, q + 1, axis=0) - lo
+        return lo.astype(jnp.int32), (qk >= 0) & (cnt > 0)
+
+    lo_c, hit_c = probe(cum_c, qk_c)
+    lo_p, hit_p = probe(cum_p, qk_p)
+    valid = hit_c & hit_p
+    n_valid = jnp.sum(valid)
+    safe_c = jnp.where(valid, lo_c, 0)
+    safe_p = jnp.where(valid, lo_p, 0)
+    g_c = tuple(
+        jnp.where(valid, jnp.take(codes, safe_c, axis=0), -1)
+        for codes in cust_codes
+    )
+    g_p = tuple(
+        jnp.where(valid, jnp.take(codes, safe_p, axis=0), -1)
+        for codes in prod_codes
+    )
+    return n_valid, lo_c, lo_p, valid, g_c, g_p
+
+
 @dataclass
 class ThreewayJoin:
     """Prepared flagship pipeline: upload once, step many times."""
@@ -120,7 +151,6 @@ class ThreewayJoin:
         both index's columns and stream's columns survive; stream wins on
         name collision; stream row order is preserved.
         """
-        lo_c, lo_p, valid = self.step()
         names_c = list(self.cust.table.columns)
         names_p = list(self.prod.table.columns)
         names_o = list(self.orders_cols)
@@ -130,20 +160,57 @@ class ThreewayJoin:
         # length there.  The scalar probe costs one extra tiny sync on
         # the partial-match path, but saves transferring the full bool
         # mask (nrows bytes) in the common all-matched case.
+        direct = (
+            self.cust.direct_cum is not None and self.prod.direct_cum is not None
+        )
+        if direct:
+            # one dispatch for probes + gathers + match count; the
+            # speculative gathers are wasted only on the rare
+            # partial-match path below
+            from ..ops.join import _aligned_codes
+
+            n_dev, lo_c, lo_p, valid, g_c, g_p = _fused_unique_join(
+                self.cust._lanes_for(self.qk_cust, "direct_cum"),
+                self.prod._lanes_for(self.qk_prod, "direct_cum"),
+                self.qk_cust,
+                self.qk_prod,
+                tuple(
+                    # a mesh-sharded stream gathers from build codes
+                    # replicated onto its mesh (broadcast-join layout)
+                    _aligned_codes(
+                        self.cust, n, self.cust.table.columns[n].codes, self.qk_cust
+                    )
+                    for n in names_c
+                ),
+                tuple(
+                    _aligned_codes(
+                        self.prod, n, self.prod.table.columns[n].codes, self.qk_prod
+                    )
+                    for n in names_p
+                ),
+            )
+        else:
+            lo_c, lo_p, valid = self.step()
         unpadded = int(lo_c.shape[0]) == self.n_orders
-        n_valid = int(jnp.sum(valid)) if unpadded else -1  # scalar sync
+        if not unpadded:
+            n_valid = -1
+        elif direct:
+            n_valid = int(n_dev)  # the one scalar sync
+        else:
+            n_valid = int(jnp.sum(valid))  # scalar sync
         if n_valid == self.n_orders:
             # every stream row matched (the referential-integrity common
-            # case): no compaction — gather build attributes by the probe
-            # ids directly and pass stream columns through untouched
-            ids_c, ids_p = lo_c, lo_p
-            ones = jnp.ones(self.n_orders, dtype=bool)
-            g_c = gather_columns(
-                ids_c, ones, *(self.cust.table.columns[n].codes for n in names_c)
-            )
-            g_p = gather_columns(
-                ids_p, ones, *(self.prod.table.columns[n].codes for n in names_p)
-            )
+            # case): no compaction — build attributes were gathered by
+            # the fused kernel (direct) or gather here; stream columns
+            # pass through untouched
+            if not direct:
+                ones = jnp.ones(self.n_orders, dtype=bool)
+                g_c = gather_columns(
+                    lo_c, ones, *(self.cust.table.columns[n].codes for n in names_c)
+                )
+                g_p = gather_columns(
+                    lo_p, ones, *(self.prod.table.columns[n].codes for n in names_p)
+                )
             g_o = tuple(self.orders_cols[n].codes for n in names_o)
             n_out = self.n_orders
         else:

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass
+from functools import partial as _partial
 from typing import ClassVar, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -126,6 +127,29 @@ def _probe_kernel_i32pair(keys_hi, keys_lo, q_hi, q_lo, r_hi, r_lo, ok):
 
 
 @jax.jit
+def _probe_kernel_direct(
+    cum: jax.Array, qk: jax.Array, range_size: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Dictionary-direct range probe: O(1) gathers instead of binary
+    search.
+
+    ``cum[j]`` = number of build keys < j over the packed-key universe
+    ``U`` (``cum`` has U+1 slots).  Because build keys are sorted,
+    ``cum[q]`` IS searchsorted-left(keys, q), so a probe is two gathers —
+    on a TPU this replaces the ~log2(n) sequential gather rounds XLA
+    emits for ``searchsorted`` (measured 1.36s -> ~0.05s for 10M probes
+    of a 100K-key build side over the tunneled v5e chip).
+    """
+    U = cum.shape[0] - 1
+    q = jnp.clip(qk, 0, U)
+    lower = jnp.take(cum, q, axis=0)
+    upper = jnp.take(cum, jnp.minimum(q + range_size, U), axis=0)
+    valid = qk >= 0
+    counts = jnp.where(valid, upper - lower, 0)
+    return lower.astype(jnp.int32), counts.astype(jnp.int32)
+
+
+@jax.jit
 def _probe_kernel_i32(
     keys: jax.Array, qk: jax.Array, range_size: jax.Array
 ) -> Tuple[jax.Array, jax.Array]:
@@ -142,6 +166,17 @@ def _probe_kernel_i32(
     return lower.astype(jnp.int32), counts.astype(jnp.int32)
 
 
+@_partial(jax.jit, static_argnames=("total_bits",))
+def _build_direct_cum(keys: jax.Array, total_bits: int) -> jax.Array:
+    """cum[j] = number of build keys strictly below j, for every packed
+    key value j in the universe [0, 2^total_bits] — one scatter-add and
+    one cumsum at index-build time."""
+    U = 1 << total_bits
+    hist = jnp.zeros(U + 1, dtype=jnp.int32)
+    hist = hist.at[keys.astype(jnp.int32) + 1].add(1, mode="drop")
+    return jnp.cumsum(hist)
+
+
 @dataclass
 class DeviceIndex:
     """Columnar build side of a join: table + packed sorted keys."""
@@ -154,6 +189,14 @@ class DeviceIndex:
     bits: Optional[List[int]] = None  # bit width per key column
     packed_hi: Optional[jax.Array] = None  # wide keys: 31-bit hi lane, device
     packed_lo: Optional[jax.Array] = None  # wide keys: 31-bit lo lane, device
+    direct_bits: Optional[int] = None  # packed-key universe bits (direct tier)
+
+    # Packed-key universes up to 2^DIRECT_MAX_BITS get the dictionary-
+    # direct probe table (2^23+1 int32 = 32MB of HBM at the cap); larger
+    # universes binary-search the sorted keys as before.
+    DIRECT_MAX_BITS: ClassVar[int] = int(
+        os.environ.get("CSVPLUS_DIRECT_PROBE_MAX_BITS", 23)
+    )
 
     # Build sides with at least this many keys probe via the range-
     # partitioned lax.all_to_all path (parallel/pjoin.py) instead of
@@ -182,7 +225,10 @@ class DeviceIndex:
             key = jnp.zeros(table.nrows, dtype=jnp.int32)
             for c, s in zip(cols, shifts):
                 key = key | (c.codes.astype(jnp.int32) << s)
-            return cls(table, key_columns, key, None, shifts, bits)
+            direct_bits = total if total <= cls.DIRECT_MAX_BITS else None
+            return cls(
+                table, key_columns, key, None, shifts, bits, direct_bits=direct_bits
+            )
 
         # wide keys: dual 31-bit int32 lanes on device; the host int64
         # copy serves point_bounds and the partitioned-path preparation
@@ -195,6 +241,22 @@ class DeviceIndex:
     @property
     def supported(self) -> bool:
         return self.shifts is not None
+
+    @property
+    def direct_cum(self) -> Optional[jax.Array]:
+        """The dictionary-direct probe table (``cum[j]`` = build keys
+        < j), built lazily on first probe — indexes used only for
+        ``find``/``point_bounds`` never pay the scatter+cumsum or the
+        up-to-32MB of HBM.  None when the universe exceeds
+        ``DIRECT_MAX_BITS``."""
+        if self.direct_bits is None:
+            return None
+        cum = getattr(self, "_direct_cum", None)
+        if cum is None:
+            cum = self._direct_cum = _build_direct_cum(
+                self.packed_i32, self.direct_bits
+            )
+        return cum
 
     def point_bounds(self, values: List[str]) -> Tuple[int, int]:
         """[lower, upper) range for one key-prefix probe — the device form
@@ -337,6 +399,9 @@ class DeviceIndex:
                 )
                 return lower, counts
 
+            if self.direct_cum is not None:
+                cum = self._lanes_for(qk, "direct_cum")
+                return _probe_kernel_direct(cum, qk, jnp.int32(1) << range_shift)
             keys = self._keys_for(qk)
             # stays on device: fan-out expansion and gathers consume these
             # directly, so no O(n) host sync happens in the probe
@@ -402,9 +467,6 @@ def expand_matches(
     offsets = np.arange(total, dtype=np.int64) - group_base
     build_ids = starts + offsets
     return probe_ids, build_ids
-
-
-from functools import partial as _partial
 
 
 @_partial(jax.jit, static_argnames=("padded_total",))

@@ -275,3 +275,41 @@ def test_load_index_device_placement(dev_people, tmp_path):
     assert back._impl.is_lazy
     assert len(back) == 120
     assert back.find("7").to_rows() == di.find("7").to_rows()
+
+
+def test_direct_probe_tier_matches_searchsorted(monkeypatch):
+    """The dictionary-direct probe (cum-table gathers) must answer every
+    probe identically to the binary-search tier: same (lower, counts) on
+    hits, misses, duplicate runs, and prefix probes."""
+    from csvplus_tpu.columnar.table import DeviceTable
+    from csvplus_tpu.ops.join import DeviceIndex
+    from csvplus_tpu.ops.sort import sort_table
+
+    rng = np.random.default_rng(11)
+    build = {
+        "k": [f"k{int(v):03d}" for v in rng.integers(0, 40, 200)],
+        "s": [f"s{int(v)}" for v in rng.integers(0, 3, 200)],
+        "v": [str(i) for i in range(200)],
+    }
+    probe = {
+        "k": [f"k{int(v):03d}" for v in rng.integers(0, 55, 500)],  # some miss
+        "s": [f"s{int(v)}" for v in rng.integers(0, 4, 500)],
+    }
+    bt = sort_table(DeviceTable.from_pylists(build), ["k", "s"])
+    pt = DeviceTable.from_pylists(probe)
+
+    with_direct = DeviceIndex.build(bt, ["k", "s"])
+    assert with_direct.direct_cum is not None
+    monkeypatch.setattr(DeviceIndex, "DIRECT_MAX_BITS", -1)
+    without = DeviceIndex.build(bt, ["k", "s"])
+    assert without.direct_cum is None
+
+    for cols in (["k", "s"], ["k"]):  # full-width and prefix probes
+        pc = [pt.columns[c] for c in cols]
+        lo_d, cnt_d = with_direct.probe(pc, pt.nrows)
+        lo_s, cnt_s = without.probe(pc, pt.nrows)
+        # lower is only meaningful where counts > 0 (miss probes may
+        # differ in clamping); counts must agree everywhere
+        assert np.array_equal(np.asarray(cnt_d), np.asarray(cnt_s))
+        hit = np.asarray(cnt_d) > 0
+        assert np.array_equal(np.asarray(lo_d)[hit], np.asarray(lo_s)[hit])
