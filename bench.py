@@ -183,7 +183,9 @@ def main() -> None:
     data = _gen_data(n_orders, n_cust, n_prod)
     device_rps = _bench_device(data, reps)
     host_rps = _bench_host(data, min(sample, n_orders))
+    _end_to_end_metrics(data, n_orders)
     _secondary_metrics(n_orders)
+    _micro_benchmarks()
 
     print(
         json.dumps(
@@ -195,6 +197,118 @@ def main() -> None:
             }
         )
     )
+
+
+def _end_to_end_metrics(data, n_orders: int) -> None:
+    """The honest tiers next to the columnar headline (to stderr): the
+    same join carried through (a) the vectorized CSV byte encoder and
+    (b) full host-row materialization — so the headline can't be read as
+    end-to-end.  Sink tiers run on a capped subsample (decode throughput
+    is row-bound, not join-bound)."""
+    try:
+        import jax
+
+        from csvplus_tpu.columnar.csvenc import encode_csv_body
+        from csvplus_tpu.columnar.table import DeviceTable
+        from csvplus_tpu.models.flagship import ThreewayJoin
+        from csvplus_tpu.ops.join import DeviceIndex
+        from csvplus_tpu.ops.sort import sort_table
+
+        n = min(n_orders, int(os.environ.get("CSVPLUS_BENCH_SINK_ROWS", 1_000_000)))
+        dev = jax.devices()[0]
+        sub = {
+            "orders": {k: v[:n] for k, v in data["orders"].items()},
+            "customers": data["customers"],
+            "products": data["products"],
+        }
+        table = lambda d: DeviceTable.from_pylists(dict(d), device=dev)
+        cust = DeviceIndex.build(sort_table(table(sub["customers"]), ["id"]), ["id"])
+        prod = DeviceIndex.build(
+            sort_table(table(sub["products"]), ["prod_id"]), ["prod_id"]
+        )
+        tw = ThreewayJoin.build(table(sub["orders"]), cust, prod)
+        joined = tw.run()  # warm (compiled above in the headline run)
+
+        cols = sorted(joined.columns)
+        t0 = time.perf_counter()
+        body = encode_csv_body(joined, cols)
+        t_csv = time.perf_counter() - t0
+        nbytes = len(body.encode("utf-8")) if body is not None else 0
+
+        t0 = time.perf_counter()
+        rows = joined.to_rows()
+        t_rows = time.perf_counter() - t0
+        assert len(rows) == n
+        sys.stderr.write(
+            f"bench[end-to-end]: join->csv-bytes {n / t_csv:,.0f} rows/s"
+            f" ({nbytes / 1e6:.0f} MB) | join->to_rows {n / t_rows:,.0f} rows/s"
+            f" (n={n})\n"
+        )
+    except Exception as e:
+        sys.stderr.write(f"bench[end-to-end] skipped: {e}\n")
+
+
+def _micro_benchmarks() -> None:
+    """Analogues of the reference's Go micro-benchmarks
+    (csvplus_test.go:1052-1186) at the reference's own scales, to stderr:
+    index build small (120 rows, unique) / big (10K rows, multi-col),
+    Find small/big, and the lookup join in BOTH directions
+    (10K orders ⋈ 120 people and 120 people ⋈ 10K orders)."""
+    try:
+        import numpy as np
+
+        from csvplus_tpu import Row, take_rows
+
+        rng = np.random.default_rng(42)
+        people = [
+            Row({"id": str(i), "name": f"name{i % 10}", "surname": f"sur{i % 12}"})
+            for i in range(120)
+        ]
+        orders = [
+            Row(
+                {
+                    "cust_id": str(int(rng.integers(0, 120))),
+                    "prod_id": f"p{int(rng.integers(0, 8))}",
+                    "qty": str(int(rng.integers(1, 100))),
+                }
+            )
+            for i in range(10_000)
+        ]
+
+        def rate(fn, reps=5):
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fn()
+                times.append(time.perf_counter() - t0)
+            return sorted(times)[len(times) // 2]
+
+        t_small = rate(lambda: take_rows(people).unique_index_on("id"))
+        t_big = rate(lambda: take_rows(orders).index_on("cust_id", "prod_id"))
+        small_idx = take_rows(people).unique_index_on("id")
+        big_idx = take_rows(orders).index_on("cust_id", "prod_id")
+        t_find_small = rate(lambda: [small_idx.find(str(i)).to_rows() for i in range(120)])
+        t_find_big = rate(
+            lambda: [big_idx.find(str(i)).to_rows() for i in range(120)]
+        )
+        t_join_fwd = rate(
+            lambda: take_rows(orders).join(small_idx, "cust_id").to_rows()
+        )
+        orders_by_cust = take_rows(orders).index_on("cust_id")
+        t_join_rev = rate(
+            lambda: take_rows(people).join(orders_by_cust, "id").to_rows()
+        )
+        sys.stderr.write(
+            "bench[micro]: index build 120u "
+            f"{120 / t_small:,.0f} rows/s | index build 10k multi "
+            f"{10_000 / t_big:,.0f} rows/s | find small "
+            f"{120 / t_find_small:,.0f} lookups/s | find big "
+            f"{120 / t_find_big:,.0f} lookups/s | join 10k>120 "
+            f"{10_000 / t_join_fwd:,.0f} rows/s | join 120>10k "
+            f"{120 / t_join_rev:,.0f} probe rows/s\n"
+        )
+    except Exception as e:
+        sys.stderr.write(f"bench[micro] skipped: {e}\n")
 
 
 def _secondary_metrics(n_orders: int) -> None:

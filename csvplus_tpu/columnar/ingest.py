@@ -179,12 +179,34 @@ def _stream_to_table(reader, path: str, device) -> DeviceTable:
         width = max(d.dtype.itemsize for d in dicts)
         dt = np.dtype(f"S{width}")
         union = np.unique(np.concatenate([d.astype(dt) for d in dicts]))
-        parts = []
-        for d, ck in zip(dicts, codes):
-            mapping = np.searchsorted(union, d.astype(dt)).astype(np.int32)
-            parts.append(jnp.take(jax.device_put(mapping, dev), ck))
-        out[c] = (union, jnp.concatenate(parts))
+        mappings = [
+            jax.device_put(np.searchsorted(union, d.astype(dt)).astype(np.int32), dev)
+            for d in dicts
+        ]
+        # all chunks remap + concatenate in ONE jit call: over a
+        # tunneled backend each eager op costs a compile per chunk
+        # shape, which dominated the wall time at north-star scale
+        out[c] = (union, _remap_concat(mappings, codes))
     return DeviceTable.from_encoded(out, nrows, device=dev)
+
+
+_remap_kernel = None
+
+
+def _remap_concat(mappings, codes):
+    global _remap_kernel
+    if _remap_kernel is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(maps, cks):
+            return jnp.concatenate(
+                [jnp.take(m, c, axis=0) for m, c in zip(maps, cks)]
+            )
+
+        _remap_kernel = kernel
+    return _remap_kernel(mappings, codes)
 
 
 def _device_parse_enabled() -> bool:

@@ -68,16 +68,15 @@ def gather_columns(ids: jax.Array, valid: jax.Array, *code_arrays: jax.Array):
 @jax.jit
 def _fused_unique_join(cum_c, cum_p, qk_c, qk_p, cust_codes, prod_codes):
     """The whole all-matched flagship join as ONE dispatch: two
-    dictionary-direct probes (see ops/join._probe_kernel_direct), the
-    validity reduction, and every build-side attribute gather.  Returns
-    the match count so the caller syncs exactly one scalar."""
+    dictionary-direct probes (ops/join.direct_probe_parts — the single
+    definition of the direct tier's semantics), the validity reduction,
+    and every build-side attribute gather.  Returns the match count so
+    the caller syncs exactly one scalar."""
+    from ..ops.join import direct_probe_parts
 
     def probe(cum, qk):
-        U = cum.shape[0] - 1
-        q = jnp.clip(qk, 0, U - 1)
-        lo = jnp.take(cum, q, axis=0)
-        cnt = jnp.take(cum, q + 1, axis=0) - lo
-        return lo.astype(jnp.int32), (qk >= 0) & (cnt > 0)
+        lo, cnt = direct_probe_parts(cum, qk, 1)
+        return lo, cnt > 0
 
     lo_c, hit_c = probe(cum_c, qk_c)
     lo_p, hit_p = probe(cum_p, qk_p)

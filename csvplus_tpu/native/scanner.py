@@ -468,6 +468,8 @@ def stream_encoded_chunks(reader, path: str, chunk_bytes: Optional[int] = None):
                 )
             except DataSourceError as e:
                 raise DataSourceError(e.line + next_record - 1, e.err)
+            if header is None and counts.shape[0] == 0:
+                continue  # comment-only chunk before the first record
             if header is None:
                 header, rec_base, field_offset, data_counts = (
                     _resolve_header_from_arrays(

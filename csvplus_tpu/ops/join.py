@@ -126,12 +126,13 @@ def _probe_kernel_i32pair(keys_hi, keys_lo, q_hi, q_lo, r_hi, r_lo, ok):
     return lower.astype(jnp.int32), counts.astype(jnp.int32)
 
 
-@jax.jit
-def _probe_kernel_direct(
-    cum: jax.Array, qk: jax.Array, range_size: jax.Array
+def direct_probe_parts(
+    cum: jax.Array, qk: jax.Array, range_size
 ) -> Tuple[jax.Array, jax.Array]:
-    """Dictionary-direct range probe: O(1) gathers instead of binary
-    search.
+    """Dictionary-direct range probe (traceable; call under jit): O(1)
+    gathers instead of binary search — the ONE definition of the direct
+    tier's semantics, shared by the generic probe kernel and the fused
+    flagship join.
 
     ``cum[j]`` = number of build keys < j over the packed-key universe
     ``U`` (``cum`` has U+1 slots).  Because build keys are sorted,
@@ -147,6 +148,13 @@ def _probe_kernel_direct(
     valid = qk >= 0
     counts = jnp.where(valid, upper - lower, 0)
     return lower.astype(jnp.int32), counts.astype(jnp.int32)
+
+
+@jax.jit
+def _probe_kernel_direct(
+    cum: jax.Array, qk: jax.Array, range_size: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    return direct_probe_parts(cum, qk, range_size)
 
 
 @jax.jit
@@ -471,16 +479,25 @@ def expand_matches(
 
 @_partial(jax.jit, static_argnames=("padded_total",))
 def _expand_kernel(lower, counts, padded_total: int):
-    """Device fan-out expansion with a static output size: exclusive
+    """Device fan-out expansion with a static output size: an exclusive
     prefix sum over counts locates each probe row's output segment, a
-    vectorized searchsorted inverts it per output slot.  Positions past
-    the true total produce clipped junk the caller slices off."""
+    scatter of segment markers + running max inverts it per output slot
+    (O(n), unlike a searchsorted inversion whose ~log n sequential
+    gather rounds dominate at the 100M-row scale).  Positions past the
+    true total produce clipped junk the caller slices off."""
     counts = counts.astype(jnp.int32)
     ends = jnp.cumsum(counts)
+    starts = ends - counts
+    # mark each non-empty segment's first output slot with the probe row
+    # id; empty segments scatter out of bounds and drop.  Segment starts
+    # are strictly increasing over non-empty segments, so no collisions.
+    ids = jnp.arange(counts.shape[0], dtype=jnp.int32)
+    mark_pos = jnp.where(counts > 0, starts, padded_total)
+    seg = jnp.zeros(padded_total, dtype=jnp.int32)
+    seg = seg.at[mark_pos].max(ids, mode="drop")
+    probe_ids = jax.lax.cummax(seg)  # fill each segment with its probe id
     out_pos = jnp.arange(padded_total, dtype=jnp.int32)
-    probe_ids = jnp.searchsorted(ends, out_pos, side="right").astype(jnp.int32)
-    probe_ids = jnp.minimum(probe_ids, counts.shape[0] - 1)
-    group_base = jnp.take(ends - counts, probe_ids, axis=0)
+    group_base = jnp.take(starts, probe_ids, axis=0)
     build_ids = jnp.take(lower.astype(jnp.int32), probe_ids, axis=0) + (
         out_pos - group_base
     )

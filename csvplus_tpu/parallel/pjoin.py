@@ -8,11 +8,11 @@ shuffle".
 Design (SPMD, static shapes throughout — no data-dependent control flow
 inside jit):
 
-* the build side is the sorted packed key array of a device index,
-  **range-partitioned**: contiguous slices of the sorted array go to each
-  shard, with slice boundaries snapped to equal-key run starts so every
-  key's full match range lives on exactly one shard (no boundary
-  straddling, no double-probing);
+* the build side of a device index is **range-partitioned over its
+  UNIQUE packed keys**: each shard owns a contiguous equal-size slice of
+  the distinct keys, and every key carries its precomputed global answer
+  (first-match row, run length) as an int32 payload — duplicates never
+  travel;
 * each shard routes its local probe keys to the owning shard via one
   ``lax.sort`` by destination + a scatter into an ``(N, C)`` slot buffer
   + ``lax.all_to_all`` (this is the ICI shuffle);
@@ -28,9 +28,14 @@ inside jit):
 Skew: PROBE-side heavy hitters are short-circuited before the exchange
 (sampled hot keys answered once via host binary search — a lookup answer
 is constant per key), and residual imbalance is absorbed by the geometric
-capacity retry.  BUILD-side skew (one key's duplicate run exceeding a
-shard slice) still lands on one shard via run-start snapping; JSPIM-style
-salting for that case remains future work.
+capacity retry.  BUILD-side skew is eliminated structurally: because a
+probe answer is just ``(global lower bound, run length)`` — the actual
+match rows are gathered later by global position — shards never need a
+heavy key's duplicate copies at all.  The build side is partitioned over
+its UNIQUE keys, each carrying a precomputed (lower, count) payload, so
+a key that owns 50% of the build rows costs its owner exactly one slot
+(the JSPIM-style salt-and-merge from PAPERS.md is unnecessary under this
+answer representation).
 """
 
 from __future__ import annotations
@@ -52,16 +57,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import pad_to_multiple, row_spec
 
 _SENTINEL = np.int32(np.iinfo(np.int32).max)
-
-
-def _flat_axis_index(axes: "tuple[str, ...]"):
-    """The device's flattened index over the (possibly multi-axis) mesh,
-    in row-major axis order — matching both ``row_spec`` data layout and
-    ``all_to_all`` over the same axis tuple."""
-    idx = lax.axis_index(axes[0])
-    for a in axes[1:]:
-        idx = idx * lax.psum(1, a) + lax.axis_index(a)
-    return idx
 
 
 # 62-bit sentinel for wide (int64) keys: packed keys keep headroom below
@@ -87,39 +82,41 @@ def split_lanes(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return hi, lo
 
 
-def partition_sorted_keys(
+def partition_build_keys(
     keys: np.ndarray, n_shards: int
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Range-partition a sorted key array (int32 or int64) into equal
-    padded slices.
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Range-partition a sorted build key array (int32 or int64) into
+    equal slices of its UNIQUE keys, each key carrying its precomputed
+    global answer.
 
-    Returns (local_keys[(N, k)] padded with the dtype's sentinel,
-    splits[(N,)] = first key per shard, base[(N,)] = global row offset
-    per shard).  Slice boundaries are snapped to run starts so one key
-    never spans two shards.
+    Returns (uniq_local[(N, k)] padded with the dtype's sentinel,
+    lower_local[(N, k)] int32 global first-match row, count_local[(N, k)]
+    int32 run length, splits[(N,)] = first unique key per shard).
+    Partitioning unique keys makes build-side skew structurally
+    impossible: a key's duplicate run contributes one slot regardless of
+    its length (see module docstring).
     """
     sent = _sentinel_for(keys.dtype)
-    n = keys.shape[0]
-    if n == 0:
+    uniq, first, counts = np.unique(keys, return_index=True, return_counts=True)
+    u = uniq.shape[0]
+    if u == 0:
         return (
             np.full((n_shards, 1), sent, dtype=keys.dtype),
+            np.zeros((n_shards, 1), dtype=np.int32),
+            np.zeros((n_shards, 1), dtype=np.int32),
             np.full(n_shards, sent, dtype=keys.dtype),
-            np.zeros(n_shards, dtype=np.int32),
         )
-    starts = np.flatnonzero(np.concatenate([[True], keys[1:] != keys[:-1]]))
-    targets = (np.arange(n_shards) * n) // n_shards
-    # boundary s = first run start >= target (so runs never straddle)
-    bidx = np.searchsorted(starts, targets, side="left")
-    bounds = np.where(
-        bidx < starts.shape[0], starts[np.minimum(bidx, starts.shape[0] - 1)], n
-    ).astype(np.int64)
-    bounds[0] = 0
-    ends = np.append(bounds[1:], n)
+    bounds = (np.arange(n_shards, dtype=np.int64) * u) // n_shards
+    ends = np.append(bounds[1:], u)
     sizes = ends - bounds
     k = max(int(sizes.max()), 1)
     local = np.full((n_shards, k), sent, dtype=keys.dtype)
+    lower = np.zeros((n_shards, k), dtype=np.int32)
+    count = np.zeros((n_shards, k), dtype=np.int32)
     for s in range(n_shards):
-        local[s, : sizes[s]] = keys[bounds[s] : ends[s]]
+        local[s, : sizes[s]] = uniq[bounds[s] : ends[s]]
+        lower[s, : sizes[s]] = first[bounds[s] : ends[s]]
+        count[s, : sizes[s]] = counts[bounds[s] : ends[s]]
     # splits must be non-decreasing for the routing binary search: an empty
     # shard inherits the NEXT non-empty shard's first key, so equal splits
     # route (via side='right') to the right-most shard — the actual owner.
@@ -129,10 +126,12 @@ def partition_sorted_keys(
         if sizes[s] > 0:
             nxt = local[s, 0]
         splits[s] = nxt
-    return local, splits, bounds.astype(np.int32)
+    return local, lower, count, splits
 
 
-def _probe_shard_kernel(n_shards: int, capacity: int, axes, qk, keys_local, splits, base):
+def _probe_shard_kernel(
+    n_shards: int, capacity: int, axes, qk, uniq_local, lower_local, count_local, splits
+):
     """Per-shard body (runs under shard_map): route, exchange, probe,
     route back.  All shapes static.  *axes* is the mesh's full axis-name
     tuple: the exchange spans the whole mesh (ICI within a slice, DCN
@@ -167,14 +166,14 @@ def _probe_shard_kernel(n_shards: int, capacity: int, axes, qk, keys_local, spli
     # ICI shuffle: slot-aligned exchange
     recv = lax.all_to_all(buf, axes, split_axis=0, concat_axis=0, tiled=True)
 
-    # vectorized local binary search over this shard's slice
+    # vectorized local search over this shard's unique-key slice; the
+    # answer (global lower, run length) is a precomputed per-key payload
     q = recv.reshape(-1)
-    lo = jnp.searchsorted(keys_local, q, side="left")
-    hi = jnp.searchsorted(keys_local, q, side="right")
-    found = (hi > lo) & (q >= 0)
-    my_base = base[_flat_axis_index(axes)]
-    resp_lo = jnp.where(found, lo.astype(jnp.int32) + my_base, -1)
-    resp_ct = jnp.where(found, (hi - lo).astype(jnp.int32), 0)
+    idx = jnp.searchsorted(uniq_local, q, side="left")
+    idx = jnp.minimum(idx, uniq_local.shape[0] - 1).astype(jnp.int32)
+    found = (jnp.take(uniq_local, idx, axis=0) == q) & (q >= 0)
+    resp_lo = jnp.where(found, jnp.take(lower_local, idx, axis=0), -1)
+    resp_ct = jnp.where(found, jnp.take(count_local, idx, axis=0), 0)
 
     # answers ride home through the same slots
     back_lo = lax.all_to_all(
@@ -203,11 +202,12 @@ def _probe_shard_kernel2(
     axes,
     qh,
     ql,
-    keys_hi,
-    keys_lo,
+    uniq_hi,
+    uniq_lo,
+    lower_local,
+    count_local,
     splits_hi,
     splits_lo,
-    base,
 ):
     """Dual-lane (62-bit key) variant of :func:`_probe_shard_kernel`:
     identical routing/exchange structure, with the key carried as two
@@ -247,12 +247,15 @@ def _probe_shard_kernel2(
 
     q_h = recv_h.reshape(-1)
     q_l = recv_l.reshape(-1)
-    lo = _searchsorted2(keys_hi, keys_lo, q_h, q_l, side="left")
-    hi = _searchsorted2(keys_hi, keys_lo, q_h, q_l, side="right")
-    found = (hi > lo) & (q_h >= 0)
-    my_base = base[_flat_axis_index(axes)]
-    resp_lo = jnp.where(found, lo.astype(jnp.int32) + my_base, -1)
-    resp_ct = jnp.where(found, (hi - lo).astype(jnp.int32), 0)
+    idx = _searchsorted2(uniq_hi, uniq_lo, q_h, q_l, side="left")
+    idx = jnp.minimum(idx, uniq_hi.shape[0] - 1).astype(jnp.int32)
+    found = (
+        (jnp.take(uniq_hi, idx, axis=0) == q_h)
+        & (jnp.take(uniq_lo, idx, axis=0) == q_l)
+        & (q_h >= 0)
+    )
+    resp_lo = jnp.where(found, jnp.take(lower_local, idx, axis=0), -1)
+    resp_ct = jnp.where(found, jnp.take(count_local, idx, axis=0), 0)
 
     back_lo = lax.all_to_all(
         resp_lo.reshape(N, C), axes, split_axis=0, concat_axis=0, tiled=True
@@ -274,60 +277,66 @@ def _probe_shard_kernel2(
 
 @partial(jax.jit, static_argnames=("mesh", "n_shards", "capacity"))
 def _probe_spmd2(
-    mesh, n_shards, capacity, qh, ql, keys_hi, keys_lo, splits_hi, splits_lo, base
+    mesh, n_shards, capacity, qh, ql, uniq_hi, uniq_lo, lower, count, splits_hi,
+    splits_lo,
 ):
     axes = tuple(mesh.axis_names)
     rows = P(axes)
     f = shard_map(
         partial(_probe_shard_kernel2, n_shards, capacity, axes),
         mesh=mesh,
-        in_specs=(rows, rows, rows, rows, P(), P(), P()),
+        in_specs=(rows, rows, rows, rows, rows, rows, P(), P()),
         out_specs=(rows, rows),
     )
-    return f(qh, ql, keys_hi, keys_lo, splits_hi, splits_lo, base)
+    return f(qh, ql, uniq_hi, uniq_lo, lower, count, splits_hi, splits_lo)
 
 
 @partial(jax.jit, static_argnames=("mesh", "n_shards", "capacity"))
-def _probe_spmd(mesh, n_shards, capacity, qk_sharded, keys_local, splits, base):
+def _probe_spmd(mesh, n_shards, capacity, qk_sharded, uniq, lower, count, splits):
     axes = tuple(mesh.axis_names)
     rows = P(axes)
     f = shard_map(
         partial(_probe_shard_kernel, n_shards, capacity, axes),
         mesh=mesh,
-        in_specs=(rows, rows, P(), P()),
+        in_specs=(rows, rows, rows, rows, P()),
         out_specs=(rows, rows),
     )
-    return f(qk_sharded, keys_local, splits, base)
+    return f(qk_sharded, uniq, lower, count, splits)
 
 
 def prepare_partitioned(mesh: Mesh, index_keys_sorted: np.ndarray):
     """Range-partition + upload the build keys once; reusable across
     probes (see DeviceIndex._partitioned_for's cache).
 
-    int32 keys -> a 3-tuple (keys, splits, base); int64 (wide, 62-bit)
-    keys -> a 5-tuple of dual 31-bit lanes (keys_hi, keys_lo, splits_hi,
-    splits_lo, base)."""
+    int32 keys -> a 4-tuple (uniq, lower, count, splits); int64 (wide,
+    62-bit) keys -> a 6-tuple with the unique keys and splits as dual
+    31-bit lanes (uniq_hi, uniq_lo, lower, count, splits_hi, splits_lo).
+    """
     n_shards = mesh.devices.size
     rows = NamedSharding(mesh, row_spec(mesh))
     repl = NamedSharding(mesh, P())
     if np.dtype(index_keys_sorted.dtype) == np.int64:
-        local, splits, base = partition_sorted_keys(index_keys_sorted, n_shards)
+        local, lower, count, splits = partition_build_keys(
+            index_keys_sorted, n_shards
+        )
         lh, ll = split_lanes(local.reshape(-1))
         sh, sl = split_lanes(splits)
         return (
             jax.device_put(lh, rows),
             jax.device_put(ll, rows),
+            jax.device_put(lower.reshape(-1), rows),
+            jax.device_put(count.reshape(-1), rows),
             jax.device_put(sh, repl),
             jax.device_put(sl, repl),
-            jax.device_put(base, repl),
         )
-    local, splits, base = partition_sorted_keys(
+    local, lower, count, splits = partition_build_keys(
         index_keys_sorted.astype(np.int32), n_shards
     )
     return (
         jax.device_put(local.reshape(-1), rows),
+        jax.device_put(lower.reshape(-1), rows),
+        jax.device_put(count.reshape(-1), rows),
         jax.device_put(splits, repl),
-        jax.device_put(base, repl),
     )
 
 
@@ -352,7 +361,7 @@ def partitioned_probe(
     wide = np.dtype(stream_keys.dtype) == np.int64
     if prepared is None:
         prepared = prepare_partitioned(mesh, index_keys_sorted)
-    assert len(prepared) == (5 if wide else 3), "prepared/key dtype mismatch"
+    assert len(prepared) == (6 if wide else 4), "prepared/key dtype mismatch"
     if not wide:
         stream_keys = stream_keys.astype(np.int32)
 
@@ -398,20 +407,22 @@ def partitioned_probe(
         qh_np, ql_np = split_lanes(qk)
         qh_dev = jax.device_put(qh_np, rows)
         ql_dev = jax.device_put(ql_np, rows)
-        kh_dev, kl_dev, sh_dev, sl_dev, base_dev = prepared
+        uh_dev, ul_dev, lower_dev, count_dev, sh_dev, sl_dev = prepared
     else:
         qk_dev = jax.device_put(qk, rows)
-        keys_dev, splits_dev, base_dev = prepared
+        uniq_dev, lower_dev, count_dev, splits_dev = prepared
 
     while True:
         if wide:
             lo, ct = _probe_spmd2(
                 mesh, n_shards, capacity,
-                qh_dev, ql_dev, kh_dev, kl_dev, sh_dev, sl_dev, base_dev,
+                qh_dev, ql_dev, uh_dev, ul_dev, lower_dev, count_dev,
+                sh_dev, sl_dev,
             )
         else:
             lo, ct = _probe_spmd(
-                mesh, n_shards, capacity, qk_dev, keys_dev, splits_dev, base_dev
+                mesh, n_shards, capacity, qk_dev, uniq_dev, lower_dev, count_dev,
+                splits_dev,
             )
         ct_np = np.asarray(ct)
         if not (ct_np < 0).any():

@@ -1,0 +1,154 @@
+"""North-star scale run: the 100M-row 3-way join, end-to-end from CSV.
+
+BASELINE.md's target configuration (orders ⋈ customers ⋈ products,
+reference pipeline csvplus.go:539-583 / README.md:54-65) at 100M orders
+rows, driven through the PUBLIC API: `FromFile(...).OnDevice()` — which
+engages the chunk-streamed ingest tier for the ~2.6GB file — then two
+`UniqueIndexOn` build sides and two chained `Join`s executed by the
+columnar device planner.
+
+Usage: python examples/northstar.py [n_orders]   (default 100_000_000)
+
+Prints per-phase rates, peak host RSS (the streamed-ingest memory bound),
+and a final JSON line for the record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DATA_DIR = os.environ.get("NORTHSTAR_DIR", "/tmp/northstar_data")
+N_CUST = 100_000
+N_PROD = 1_000
+
+
+def _rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def generate(n_orders: int) -> str:
+    """Write orders/customers/products CSVs (cached across runs)."""
+    os.makedirs(DATA_DIR, exist_ok=True)
+    opath = os.path.join(DATA_DIR, f"orders_{n_orders}.csv")
+    cpath = os.path.join(DATA_DIR, "customers.csv")
+    ppath = os.path.join(DATA_DIR, "products.csv")
+    if not os.path.exists(cpath):
+        with open(cpath, "w") as f:
+            f.write("id,name\n")
+            for i in range(N_CUST):
+                f.write(f"c{i},name{i % 9973}\n")
+    if not os.path.exists(ppath):
+        with open(ppath, "w") as f:
+            f.write("prod_id,product,price\n")
+            for i in range(N_PROD):
+                f.write(f"p{i},prod{i},{(i % 9900) / 100 + 0.99:.2f}\n")
+    if not os.path.exists(opath):
+        rng = np.random.default_rng(20160914)
+        t0 = time.perf_counter()
+        with open(opath, "w") as f:
+            f.write("cust_id,prod_id,qty\n")
+            chunk = 2_000_000
+            for base in range(0, n_orders, chunk):
+                n = min(chunk, n_orders - base)
+                cust = rng.integers(0, N_CUST, n)
+                prod = rng.integers(0, N_PROD, n)
+                qty = rng.integers(1, 101, n)
+                lines = np.char.add(
+                    np.char.add(
+                        np.char.add("c", cust.astype(np.str_)),
+                        np.char.add(",p", prod.astype(np.str_)),
+                    ),
+                    np.char.add(",", qty.astype(np.str_)),
+                )
+                f.write("\n".join(lines.tolist()))
+                f.write("\n")
+                print(
+                    f"  gen {base + n:,}/{n_orders:,} rows"
+                    f" ({time.perf_counter() - t0:,.0f}s)",
+                    file=sys.stderr,
+                )
+    return opath
+
+
+def main() -> None:
+    n_orders = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000_000
+    opath = generate(n_orders)
+    print(
+        f"orders file: {opath} ({os.path.getsize(opath) / 1e9:.2f} GB), "
+        f"rss after gen {_rss_mb():,.0f} MB",
+        file=sys.stderr,
+    )
+
+    import jax
+
+    from csvplus_tpu import FromFile, Take
+
+    backend = jax.default_backend()
+    t0 = time.perf_counter()
+    orders = FromFile(opath).OnDevice()
+    # sync ingest (async dispatch would stop the clock early)
+    for col in orders.plan.table.columns.values():
+        np.asarray(col.codes[:1])
+    t_ingest = time.perf_counter() - t0
+    rss_ingest = _rss_mb()
+    print(
+        f"ingest: {n_orders / t_ingest:,.0f} rows/s ({t_ingest:,.1f}s), "
+        f"peak rss {rss_ingest:,.0f} MB",
+        file=sys.stderr,
+    )
+
+    t0 = time.perf_counter()
+    cust_idx = (
+        FromFile(os.path.join(DATA_DIR, "customers.csv"))
+        .OnDevice()
+        .UniqueIndexOn("id")
+    )
+    prod_idx = (
+        FromFile(os.path.join(DATA_DIR, "products.csv"))
+        .OnDevice()
+        .UniqueIndexOn("prod_id")
+    )
+    t_index = time.perf_counter() - t0
+    print(f"index build (device, 101K rows): {t_index:,.1f}s", file=sys.stderr)
+
+    # the join itself: columnar planner, device probe + gathers
+    from csvplus_tpu.columnar.exec import execute_plan
+
+    joined = orders.Join(cust_idx, "cust_id").Join(prod_idx)
+    t0 = time.perf_counter()
+    table = execute_plan(joined.plan)
+    for col in table.columns.values():
+        np.asarray(col.codes[:1])
+    t_join = time.perf_counter() - t0
+    assert table.nrows == n_orders, table.nrows
+    print(
+        f"3-way join: {n_orders / t_join:,.0f} rows/s ({t_join:,.2f}s), "
+        f"{table.nrows:,} result rows",
+        file=sys.stderr,
+    )
+
+    total = time.perf_counter()
+    print(
+        json.dumps(
+            {
+                "metric": "northstar_threeway_join",
+                "rows": n_orders,
+                "backend": backend,
+                "ingest_rows_per_sec": round(n_orders / t_ingest, 1),
+                "join_rows_per_sec": round(n_orders / t_join, 1),
+                "peak_host_rss_mb": round(_rss_mb(), 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

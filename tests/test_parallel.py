@@ -11,7 +11,7 @@ from csvplus_tpu import Take, from_file
 from csvplus_tpu.parallel.mesh import make_mesh, replicate, shard_rows
 from csvplus_tpu.parallel.pjoin import (
     broadcast_probe,
-    partition_sorted_keys,
+    partition_build_keys,
     partitioned_probe,
 )
 
@@ -41,23 +41,59 @@ def test_sharded_table_roundtrip(people_csv, mesh):
     assert st.to_rows() == table.to_rows()
 
 
-def test_partition_sorted_keys_covers_all():
+def test_partition_build_keys_covers_all():
     keys = np.sort(np.random.default_rng(1).integers(0, 100, 1000).astype(np.int32))
-    local, splits, base = partition_sorted_keys(keys, 8)
-    # every real key appears exactly once across shards
-    got = local[local != np.iinfo(np.int32).max]
-    assert np.array_equal(np.sort(got), keys)
-    # no key run straddles shards
-    for s in range(1, 8):
-        sz = (local[s - 1] != np.iinfo(np.int32).max).sum()
-        if sz and (local[s] != np.iinfo(np.int32).max).sum():
-            assert local[s - 1][sz - 1] != local[s][0]
+    local, lower, count, splits = partition_build_keys(keys, 8)
+    sent = np.iinfo(np.int32).max
+    real = local != sent
+    # every unique key appears exactly once across shards, with its
+    # (global lower, run length) payload reconstructing the full array
+    got = local[real]
+    assert np.array_equal(np.sort(got), np.unique(keys))
+    for s in range(8):
+        for k, lo, ct in zip(local[s][real[s]], lower[s][real[s]], count[s][real[s]]):
+            assert (keys[lo : lo + ct] == k).all()
+            assert ct == (keys == k).sum()
+
+
+def test_partition_build_keys_heavy_key_balanced():
+    """Build-side skew: one key owning 50% of the rows costs one slot —
+    per-shard slot use stays balanced (VERDICT round-1 weak #6)."""
+    rng = np.random.default_rng(3)
+    heavy = np.full(5000, 77, dtype=np.int32)
+    rest = rng.integers(0, 1000, 5000).astype(np.int32)
+    keys = np.sort(np.concatenate([heavy, rest]))
+    local, lower, count, splits = partition_build_keys(keys, 8)
+    sent = np.iinfo(np.int32).max
+    sizes = (local != sent).sum(axis=1)
+    assert sizes.max() - sizes.min() <= 1  # equal unique-key slices
+    # the heavy key's payload is exact
+    s, j = np.argwhere(local == 77)[0]
+    assert count[s, j] == 5000 + (rest == 77).sum()
+    assert (keys[lower[s, j] : lower[s, j] + count[s, j]] == 77).all()
 
 
 def test_partitioned_probe_differential(mesh):
     rng = np.random.default_rng(2)
     keys = np.sort(rng.integers(0, 5000, size=20_000).astype(np.int32))
     queries = rng.integers(-10, 6000, size=30_001).astype(np.int32)
+    queries[queries < 0] = -1
+    lo, ct = partitioned_probe(mesh, queries, keys)
+    olo = np.searchsorted(keys, queries, side="left").astype(np.int32)
+    oct_ = (np.searchsorted(keys, queries, side="right") - olo).astype(np.int32)
+    oct_[queries < 0] = 0
+    assert (ct == oct_).all()
+    hit = ct > 0
+    assert (lo[hit] == olo[hit]).all()
+
+
+def test_partitioned_probe_heavy_build_key(mesh):
+    """End-to-end exchange with 50% build-side skew: exact answers."""
+    rng = np.random.default_rng(7)
+    heavy = np.full(10_000, 1234, dtype=np.int32)
+    rest = rng.integers(0, 3000, 10_000).astype(np.int32)
+    keys = np.sort(np.concatenate([heavy, rest]))
+    queries = rng.integers(-5, 3500, size=20_001).astype(np.int32)
     queries[queries < 0] = -1
     lo, ct = partitioned_probe(mesh, queries, keys)
     olo = np.searchsorted(keys, queries, side="left").astype(np.int32)

@@ -140,3 +140,14 @@ def test_stream_threshold_respected(tmp_path, monkeypatch):
     with telemetry.collect() as records:
         from_file(path).on_device().to_rows()
     assert not any(r.stage == "ingest:streamed" for r in records)
+
+
+def test_stream_comment_only_first_chunk(tmp_path):
+    """A first chunk holding only comment lines must not hard-fail: the
+    header resolves from the first chunk that has records."""
+    text = "#c1\n#c2\n#c3\n" + "a,b\n1,2\n3,4\n"
+    path = _write(tmp_path, text)
+    mk = lambda: from_file(path).comment_char("#")
+    names, cols, total = _collect(mk(), path, 4)  # comments span chunks
+    assert total == 2
+    assert cols == mk().read_columns()[1]
