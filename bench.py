@@ -140,23 +140,32 @@ def _bench_host(data, sample: int) -> float:
 
 def _ensure_live_backend() -> None:
     """Guard against a wedged accelerator tunnel: probe JAX backend init
-    in a subprocess with a deadline; on failure re-exec this benchmark in
-    a hermetic CPU environment so the driver ALWAYS gets its JSON line.
+    in a subprocess with a deadline, retrying a few times (tunnels wedge
+    transiently); on persistent failure re-exec this benchmark in a
+    hermetic CPU environment so the driver ALWAYS gets its JSON line.
     """
     import subprocess
 
     if os.environ.get("CSVPLUS_BENCH_HERMETIC") == "1":
         return
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=int(os.environ.get("CSVPLUS_BENCH_PROBE_TIMEOUT", 180)),
-            capture_output=True,
-        )
-        if probe.returncode == 0:
-            return  # backend healthy
-    except subprocess.TimeoutExpired:
-        pass
+    timeout = int(os.environ.get("CSVPLUS_BENCH_PROBE_TIMEOUT", 120))
+    retries = int(os.environ.get("CSVPLUS_BENCH_PROBE_RETRIES", 3))
+    for attempt in range(retries):
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout,
+                capture_output=True,
+            )
+            if probe.returncode == 0:
+                return  # backend healthy
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt + 1 < retries:
+            sys.stderr.write(
+                f"bench: backend probe {attempt + 1}/{retries} failed; retrying\n"
+            )
+            time.sleep(int(os.environ.get("CSVPLUS_BENCH_PROBE_BACKOFF", 30)))
     sys.stderr.write(
         "bench: accelerator backend unreachable; falling back to CPU\n"
     )

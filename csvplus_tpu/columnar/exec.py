@@ -32,7 +32,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import plan as P
-from ..errors import DataSourceError
+from ..errors import CsvPlusError, DataSourceError
 from ..row import MissingColumnError, Row
 from .table import DeviceTable, StringColumn
 
@@ -61,7 +61,7 @@ class _View:
     stream (base 0), so its errors are numbered by streamed position.
     """
 
-    __slots__ = ("cols", "sel", "device", "full_len", "scan_base")
+    __slots__ = ("cols", "sel", "device", "full_len", "scan_base", "deferred_error")
 
     def __init__(
         self,
@@ -76,10 +76,16 @@ class _View:
         self.device = device
         self.full_len = full_len
         self.scan_base = scan_base
+        # (stream index of the first validate failure, the exception) —
+        # fired by consumers only if streaming reaches that row
+        self.deferred_error = None
 
     def materialize(self) -> DeviceTable:
         gathered = {n: c.gather(self.sel) for n, c in self.cols.items()}
-        return DeviceTable(gathered, int(self.sel.shape[0]), self.device)
+        table = DeviceTable(gathered, int(self.sel.shape[0]), self.device)
+        if self.deferred_error is not None:
+            table.deferred_error = self.deferred_error
+        return table
 
 
 def _linearize(node: P.PlanNode) -> List[P.PlanNode]:
@@ -105,6 +111,16 @@ def execute_plan_view(root: P.PlanNode) -> "_View":
     """Run the plan, returning the final executor view (columns +
     selection vector + source row numbering) without materializing."""
     stages = _linearize(root)
+    # Validate lowers only as the FINAL stage.  Upstream of anything
+    # else, the host's push semantics (check rows one by one, stop the
+    # moment downstream stops) cannot be reproduced by an eager device
+    # check — and even terminal validates defer their failure to
+    # streaming time (see the P.Validate branch) so a consumer that
+    # stops early never observes an error the host would not have
+    # raised.  Parity wins (plan.py).
+    for node in stages[:-1]:
+        if isinstance(node, P.Validate):
+            raise UnsupportedPlan("Validate is device-lowered only as last stage")
     scan = stages[0]
     assert isinstance(scan, P.Scan)
     table: DeviceTable = scan.table
@@ -150,6 +166,21 @@ def _exec_stage(view: "_View", node: P.PlanNode) -> "_View":
         # device compaction: boolean gather over the selection; only the
         # compacted size crosses to host (implicit in the eager shape)
         view.sel = view.sel[jnp.take(mask, view.sel, axis=0)]
+    elif isinstance(node, P.Validate):
+        nrows = _full_len(view)
+        try:
+            mask = build_mask(view.cols, nrows, node.pred)
+        except UnsupportedPredicate as e:
+            raise UnsupportedPlan(str(e)) from e
+        bad = ~jnp.take(mask, view.sel, axis=0)
+        if bool(jnp.any(bad)):  # one scalar sync on the happy path
+            i = int(jnp.argmax(bad))  # device argmax -> first failure
+            rowno = view.scan_base + int(view.sel[i])
+            # DEFERRED: the failure fires only if streaming actually
+            # reaches row i — a consumer stopping earlier (Top's EOF,
+            # a user StopPipeline) must end cleanly, like the host's
+            # per-row push check (csvplus.go:300-310)
+            view.deferred_error = (i, DataSourceError(rowno, CsvPlusError(node.message)))
     elif isinstance(node, P.TakeWhile) or isinstance(node, P.DropWhile):
         nrows = _full_len(view)
         try:
@@ -345,13 +376,21 @@ def _apply_map(view: _View, expr) -> None:
 
 
 def try_execute_plan(root: Optional[P.PlanNode]) -> Optional[List[Row]]:
-    """Execute the plan to host Rows, or None when not device-executable."""
+    """Execute the plan to host Rows, or None when not device-executable.
+
+    A failing terminal Validate raises here: a full materialization
+    consumes every row, so the host stream would always have reached the
+    first invalid row."""
     if root is None:
         return None
     try:
-        return execute_plan(root).to_rows()
+        table = execute_plan(root)
     except UnsupportedPlan:
         return None
+    de = getattr(table, "deferred_error", None)
+    if de is not None:
+        raise de[1]
+    return table.to_rows()
 
 
 def device_table_for(src) -> "DeviceTable | None":
@@ -365,13 +404,19 @@ def device_table_for(src) -> "DeviceTable | None":
     if plan is None or getattr(src, "_plan_unsupported", False):
         return None
     try:
-        return execute_plan(plan)
+        table = execute_plan(plan)
     except UnsupportedPlan:
         try:
             src._plan_unsupported = True
         except AttributeError:
             pass
         return None
+    if getattr(table, "deferred_error", None) is not None:
+        # a failing terminal Validate: sinks must replay the host
+        # streaming path for exact write-then-remove semantics.  Data-
+        # dependent, so do NOT memoize unsupported.
+        return None
+    return table
 
 
 def plan_runner(root: P.PlanNode, fallback=None, owner=None):
@@ -397,6 +442,22 @@ def plan_runner(root: P.PlanNode, fallback=None, owner=None):
             return
         from ..source import iterate
 
+        de = getattr(table, "deferred_error", None)
+        if de is not None:
+            # stream up to the first invalid row; the error fires only
+            # if the consumer is still listening when we reach it
+            k, err = de
+            delivered = 0
+
+            def counting(row):
+                nonlocal delivered
+                fn(row)
+                delivered += 1
+
+            iterate(table.to_rows()[:k], counting, clone=False)
+            if delivered == k:  # consumer did not stop early
+                raise err
+            return
         # rows are freshly decoded per run, so skip the defensive clone
         iterate(table.to_rows(), fn, clone=False)
 

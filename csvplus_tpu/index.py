@@ -494,6 +494,10 @@ def _create_index_device(plan, columns: Tuple[str, ...]) -> Index:
     from .ops.sort import sort_table
 
     view = execute_plan_view(plan)
+    if view.deferred_error is not None:
+        # index build consumes every row, so the host stream always
+        # reaches the first row failing a terminal Validate
+        raise view.deferred_error[1]
     if view.sel.shape[0] == 0:
         # the host build validates per-row (csvplus.go:722-733), so an
         # empty source yields an empty index without any column check

@@ -95,6 +95,17 @@ def _fused_unique_join(cum_c, cum_p, qk_c, qk_p, cust_codes, prod_codes):
     return n_valid, lo_c, lo_p, valid, g_c, g_p
 
 
+@jax.jit
+def _fused_direct_probe(cum_c, cum_p, qk_c, qk_p):
+    """Probe-only variant of :func:`_fused_unique_join` for padded
+    (mesh-sharded) streams, which always compact afterwards."""
+    from ..ops.join import direct_probe_parts
+
+    lo_c, cnt_c = direct_probe_parts(cum_c, qk_c, 1)
+    lo_p, cnt_p = direct_probe_parts(cum_p, qk_p, 1)
+    return lo_c, lo_p, (cnt_c > 0) & (cnt_p > 0)
+
+
 @dataclass
 class ThreewayJoin:
     """Prepared flagship pipeline: upload once, step many times."""
@@ -162,7 +173,10 @@ class ThreewayJoin:
         direct = (
             self.cust.direct_cum is not None and self.prod.direct_cum is not None
         )
-        if direct:
+        # padded (mesh-sharded) streams always take the compaction path,
+        # so their fused call skips the speculative gathers entirely
+        unpadded = int(self.qk_cust.shape[0]) == self.n_orders
+        if direct and unpadded:
             # one dispatch for probes + gathers + match count; the
             # speculative gathers are wasted only on the rare
             # partial-match path below
@@ -188,9 +202,16 @@ class ThreewayJoin:
                     for n in names_p
                 ),
             )
+        elif direct:
+            # padded stream: direct probes (no speculative gathers)
+            lo_c, lo_p, valid = _fused_direct_probe(
+                self.cust._lanes_for(self.qk_cust, "direct_cum"),
+                self.prod._lanes_for(self.qk_prod, "direct_cum"),
+                self.qk_cust,
+                self.qk_prod,
+            )
         else:
             lo_c, lo_p, valid = self.step()
-        unpadded = int(lo_c.shape[0]) == self.n_orders
         if not unpadded:
             n_valid = -1
         elif direct:

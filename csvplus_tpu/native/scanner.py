@@ -315,7 +315,7 @@ def read_device_parsed_columns(reader, path: str):
         return None
     starts, lens, counts, data_dev = parsed
 
-    header, rec_base, field_offset, data_counts = _resolve_header_from_arrays(
+    header, rec_base, field_offset, data_counts, _ = _resolve_header_from_arrays(
         reader, data, b"", starts, lens, counts
     )
 
@@ -336,10 +336,25 @@ def read_device_parsed_columns(reader, path: str):
     return list(header), out
 
 
+def _check_field_counts(data_counts, expected: int, first_record: int) -> int:
+    """Field-count policy over data records (csvplus.go:1121-1130),
+    shared by the whole-file and streamed tiers: lock *expected* from
+    the first record when auto (0), then every record must match.
+    Returns the (possibly locked) expected width."""
+    if data_counts.shape[0]:
+        if expected == 0:
+            expected = int(data_counts[0])
+        bad = np.flatnonzero(data_counts != expected)
+        if bad.size:
+            raise DataSourceError(int(bad[0]) + first_record, ERR_FIELD_COUNT)
+    return expected
+
+
 def _resolve_header_from_arrays(reader, data, scratch, starts, lens, counts):
     """Header + field-count policy over pre-scanned offset arrays — the
-    single implementation behind _scan_for_reader (native tiers) and the
-    device-parsed tier.  Raises DataSourceError; never returns None."""
+    single implementation behind _scan_for_reader (native tiers), the
+    device-parsed tier and the streamed tier's first chunk.  Raises
+    DataSourceError; never returns None."""
     nrec = counts.shape[0]
     expected = reader._num_fields
     if reader._header_from_first_row:
@@ -363,13 +378,9 @@ def _resolve_header_from_arrays(reader, data, scratch, starts, lens, counts):
         rec_base = 1
         field_offset = 0
         data_counts = counts
-    if reader._num_fields >= 0 and data_counts.shape[0]:
-        if expected == 0:
-            expected = int(data_counts[0])
-        bad = np.flatnonzero(data_counts != expected)
-        if bad.size:
-            raise DataSourceError(int(bad[0]) + rec_base, ERR_FIELD_COUNT)
-    return header, rec_base, field_offset, data_counts
+    if reader._num_fields >= 0:
+        expected = _check_field_counts(data_counts, expected, rec_base)
+    return header, rec_base, field_offset, data_counts, expected
 
 
 def read_encoded_columns_native(reader, path: str):
@@ -471,27 +482,22 @@ def stream_encoded_chunks(reader, path: str, chunk_bytes: Optional[int] = None):
             if header is None and counts.shape[0] == 0:
                 continue  # comment-only chunk before the first record
             if header is None:
-                header, rec_base, field_offset, data_counts = (
+                # first chunk with records: header + field-count policy
+                # resolve exactly as the whole-file tiers do
+                header, rec_base, field_offset, data_counts, expected = (
                     _resolve_header_from_arrays(
                         reader, data, scratch, starts, lens, counts
                     )
                 )
-                if reader._header_from_first_row:
-                    if expected == 0:
-                        expected = int(counts[0])
                 names = list(header)
                 first_data_record = rec_base
             else:
                 field_offset = 0
                 data_counts = counts
                 first_data_record = next_record
-            if reader._num_fields >= 0 and data_counts.shape[0]:
-                if expected == 0:
-                    expected = int(data_counts[0])
-                bad = np.flatnonzero(data_counts != expected)
-                if bad.size:
-                    raise DataSourceError(
-                        int(bad[0]) + first_data_record, ERR_FIELD_COUNT
+                if reader._num_fields >= 0:
+                    expected = _check_field_counts(
+                        data_counts, expected, first_data_record
                     )
             next_record += int(counts.shape[0])
 
@@ -530,7 +536,7 @@ def _scan_for_reader(reader, path: str):
         comment=reader._comment,
         lazy_quotes=reader._lazy_quotes,
     )
-    header, rec_base, field_offset, _ = _resolve_header_from_arrays(
+    header, rec_base, field_offset, _counts, _ = _resolve_header_from_arrays(
         reader, data, scratch, starts, lens, counts
     )
     return data, starts, lens, counts, scratch, header, rec_base, field_offset

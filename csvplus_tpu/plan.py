@@ -50,6 +50,21 @@ class Filter(PlanNode):
 
 
 @dataclass(frozen=True)
+class Validate(PlanNode):
+    """Symbolic per-row check: every selected row must satisfy ``pred``
+    or the pipeline aborts with ``message`` at the first failing row
+    (device form of csvplus.go:300-310 with a predicate instead of an
+    opaque error-returning callback)."""
+
+    child: PlanNode
+    pred: Any  # symbolic predicate
+    message: str
+
+    def __repr__(self) -> str:
+        return f"Validate({self.pred!r}) <- {self.child!r}"
+
+
+@dataclass(frozen=True)
 class MapExpr(PlanNode):
     child: PlanNode
     expr: Any  # symbolic row transform (exprs.Rename / SetValue / ...)
@@ -128,6 +143,14 @@ def _is_symbolic(obj: Any) -> bool:
 def filter_plan(child: Optional[PlanNode], pred: Any) -> Optional[PlanNode]:
     if child is not None and _is_symbolic(pred):
         return Filter(child, pred)
+    return None
+
+
+def validate_plan(
+    child: Optional[PlanNode], vf: Any, message: str
+) -> Optional[PlanNode]:
+    if child is not None and _is_symbolic(vf):
+        return Validate(child, vf, message)
     return None
 
 

@@ -307,6 +307,16 @@ class Reader:
         """
         from .columnar.ingest import reader_to_device
 
+        # host-path parity for file errors ("row 1: open: ...", the
+        # reference's mapError of path errors, csvplus.go:1209-1227):
+        # probe-open with the host's own wrapper BEFORE ingest, so only
+        # the open step is mapped — a mid-ingest I/O error propagates
+        # as itself rather than masquerading as an open failure
+        if getattr(self, "_path", None) is not None:
+            # path sources only: never consume or close a caller-supplied
+            # stream (FromReader/FromReadCloser)
+            _stream, closer = self._open(line_no=1)
+            closer()
         return reader_to_device(self, device=device, shards=shards, mesh=mesh, **opts)
 
     # Go-style aliases

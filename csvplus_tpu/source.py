@@ -33,7 +33,7 @@ import queue as _queue
 import threading as _threading
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
 
-from .errors import DataSourceError, StopPipeline
+from .errors import CsvPlusError, DataSourceError, StopPipeline
 from .row import Row, merge_rows
 
 #: A row callback: called once per row; raise :class:`StopPipeline` to
@@ -189,9 +189,35 @@ class DataSource:
         from .plan import map_plan
         return _make(run, map_plan(self.plan, mf), self, "map", mf)
 
-    def validate(self, vf: Callable[[Row], None]) -> "DataSource":
+    def validate(
+        self, vf: Callable[[Row], "None | bool"], message: str = "validation failed"
+    ) -> "DataSource":
         """Check every row; *vf* raises to fail the pipeline at that row
-        (csvplus.go:300-310)."""
+        (csvplus.go:300-310).
+
+        Passing a symbolic predicate (``Like``/``All``/``Any``/``Not``)
+        instead of a raising callback keeps the check on device: the
+        fused mask is reduced and the pipeline aborts with *message* —
+        wrapped with the first failing row's source number — exactly
+        like the host path.
+        """
+        from .predicates import Predicate
+
+        if isinstance(vf, Predicate):
+            pred = vf
+
+            def run(fn: RowFunc) -> None:
+                def step(row: Row) -> None:
+                    if not pred(row):
+                        raise CsvPlusError(message)
+                    fn(row)
+
+                self._run(step)
+
+            from .plan import validate_plan
+            return _make(
+                run, validate_plan(self.plan, pred, message), self, "validate", pred
+            )
 
         def run(fn: RowFunc) -> None:
             def step(row: Row) -> None:

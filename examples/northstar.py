@@ -135,7 +135,6 @@ def main() -> None:
         file=sys.stderr,
     )
 
-    total = time.perf_counter()
     print(
         json.dumps(
             {

@@ -820,3 +820,79 @@ def test_expand_matches_device_empty():
         jnp.zeros(0, dtype=jnp.int32), jnp.zeros(0, dtype=jnp.int32)
     )
     assert p.shape == (0,) and b.shape == (0,)
+
+
+def test_symbolic_validate_device_vs_host(people_csv):
+    """Validate with a symbolic predicate runs on device and matches the
+    host path: pass-through on success, row-numbered failure otherwise."""
+    from csvplus_tpu import DataSourceError, Like, Not, Take, from_file
+
+    ok_pred = Not(Like({"name": "___nope___"}))
+    dev = from_file(people_csv).on_device("cpu").validate(ok_pred).to_rows()
+    host = Take(from_file(people_csv)).validate(ok_pred).to_rows()
+    assert dev == host and len(dev) == 120
+
+    # symbolic validate stays on the device plan
+    src = from_file(people_csv).on_device("cpu").validate(ok_pred)
+    assert src.plan is not None
+
+    bad = Like({"name": "___nope___"})
+    with pytest.raises(DataSourceError) as dev_err:
+        from_file(people_csv).on_device("cpu").validate(bad, "bad name").to_rows()
+    with pytest.raises(DataSourceError) as host_err:
+        Take(from_file(people_csv)).validate(bad, "bad name").to_rows()
+    assert str(dev_err.value) == str(host_err.value)
+    assert "bad name" in str(dev_err.value)
+
+
+def test_symbolic_validate_failure_row_number(tmp_path):
+    from csvplus_tpu import DataSourceError, Like, Take, from_file
+
+    p = tmp_path / "v.csv"
+    p.write_text("k\nok\nok\nBAD\nok\n")
+    pred = Like({"k": "ok"})
+    with pytest.raises(DataSourceError) as dev_err:
+        from_file(str(p)).on_device("cpu").validate(pred).to_rows()
+    with pytest.raises(DataSourceError) as host_err:
+        Take(from_file(str(p))).validate(pred).to_rows()
+    # record 1 is the header; BAD is record 4
+    assert dev_err.value.line == host_err.value.line == 4
+
+
+def test_on_device_missing_file_error_parity():
+    """OnDevice on a nonexistent path raises the host path's row-numbered
+    open error (csvplus.go:1209-1227), not a raw OSError."""
+    from csvplus_tpu import DataSourceError, Take, from_file
+
+    with pytest.raises(DataSourceError) as dev_err:
+        from_file("/tmp/___no_such_file___.csv").on_device("cpu").to_rows()
+    with pytest.raises(DataSourceError) as host_err:
+        Take(from_file("/tmp/___no_such_file___.csv")).to_rows()
+    assert str(dev_err.value) == str(host_err.value)
+
+
+def test_symbolic_validate_before_top_host_parity(tmp_path):
+    """Validate upstream of Top falls back to host semantics: rows past
+    the early stop are never validated (review regression)."""
+    from csvplus_tpu import Like, Take, from_file
+
+    p = tmp_path / "vt.csv"
+    p.write_text("k\nok\nok\nok\nBAD\n")
+    pred = Like({"k": "ok"})
+    host = Take(from_file(str(p))).validate(pred).top(2).to_rows()
+    dev = from_file(str(p)).on_device("cpu").validate(pred).top(2).to_rows()
+    assert dev == host and len(dev) == 2  # host never reaches BAD
+
+
+def test_symbolic_validate_sink_file_removed(tmp_path):
+    """A failing validate through to_csv_file keeps the no-partial-output
+    contract on both paths (csvplus.go:418-443)."""
+    from csvplus_tpu import DataSourceError, Like, Take, from_file
+
+    p = tmp_path / "vs.csv"
+    p.write_text("k\nok\nBAD\nok\n")
+    out = tmp_path / "out.csv"
+    pred = Like({"k": "ok"})
+    with pytest.raises(DataSourceError):
+        from_file(str(p)).on_device("cpu").validate(pred).to_csv_file(str(out), "k")
+    assert not out.exists()
