@@ -454,7 +454,8 @@ def plan_runner(root: P.PlanNode, fallback=None, owner=None):
                 fn(row)
                 delivered += 1
 
-            iterate(table.to_rows()[:k], counting, clone=False)
+            # decode ONLY the rows before the failure point
+            iterate(table.to_rows(np.arange(k)), counting, clone=False)
             if delivered == k:  # consumer did not stop early
                 raise err
             return

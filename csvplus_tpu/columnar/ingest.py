@@ -159,11 +159,12 @@ def _stream_to_table(reader, path: str, device) -> DeviceTable:
     from .table import default_device
 
     dev = default_device(device)
+    encoder = _device_chunk_encoder(dev) if _device_parse_enabled() else None
     names = None
     chunk_dicts: "dict[str, list]" = {}
     chunk_codes: "dict[str, list]" = {}
     nrows = 0
-    for cnames, encoded, n in stream_encoded_chunks(reader, path):
+    for cnames, encoded, n in stream_encoded_chunks(reader, path, encoder=encoder):
         if names is None:
             names = cnames
             chunk_dicts = {c: [] for c in names}
@@ -196,6 +197,40 @@ def _stream_to_table(reader, path: str, device) -> DeviceTable:
         # shape, which dominated the wall time at north-star scale
         out[c] = (union, _remap_concat(mappings, codes))
     return DeviceTable.from_encoded(out, nrows, device=dev)
+
+
+def _device_chunk_encoder(device):
+    """Per-chunk column encoder that runs the heavy dictionary encode ON
+    DEVICE (ops/parse sort-rank kernel): the chunk's byte tensor uploads
+    once (size-bucketed) and each column's codes are born on device —
+    the streamed tier's marriage with the device-parse tier.  Declines
+    (returns None per column) on fields wider than the kernel's 32-byte
+    cap; the caller then uses the host vectorized encode."""
+    import jax
+
+    state: dict = {}
+
+    def encode(combined, data, col_starts, col_lens):
+        import numpy as np
+
+        from ..ops.parse import _bucket_len, encode_column_device
+
+        if len(data) >= 2**31:
+            return None  # int32 offsets would wrap (ops/parse.py guard)
+        if state.get("data") is not data:
+            padded = _bucket_len(len(data))
+            host_arr = np.frombuffer(data, dtype=np.uint8)
+            if padded != len(data):
+                host_arr = np.concatenate(
+                    [host_arr, np.zeros(padded - len(data), dtype=np.uint8)]
+                )
+            # holding the bytes object keeps the identity check sound
+            # (costs one chunk of extra host memory, freed next chunk)
+            state["data"] = data
+            state["dev"] = jax.device_put(host_arr, device)
+        return encode_column_device(state["dev"], data, col_starts, col_lens)
+
+    return encode
 
 
 _remap_kernel = None

@@ -431,7 +431,9 @@ def _stream_chunk_bytes() -> int:
     return int(v) if v else _STREAM_CHUNK_BYTES
 
 
-def stream_encoded_chunks(reader, path: str, chunk_bytes: Optional[int] = None):
+def stream_encoded_chunks(
+    reader, path: str, chunk_bytes: Optional[int] = None, encoder=None
+):
     """Generator over newline-aligned file chunks, each natively scanned
     and dictionary-encoded with zero per-cell Python objects.
 
@@ -447,6 +449,11 @@ def stream_encoded_chunks(reader, path: str, chunk_bytes: Optional[int] = None):
     or a field longer than the vectorized-encode limit.  Field-count and
     header errors raise :class:`DataSourceError` with ABSOLUTE 1-based
     record numbers, identical to the whole-file paths.
+
+    *encoder*, when given, is tried first for each column:
+    ``encoder(combined_u8, data_bytes, col_starts, col_lens)`` returns
+    ``(dictionary, codes)`` or None to decline (then the host vectorized
+    encode runs) — the hook the device-encode ingest tier plugs in.
     """
     if reader._trim_leading_space:
         raise StreamFallback("trim")
@@ -511,7 +518,13 @@ def stream_encoded_chunks(reader, path: str, chunk_bytes: Optional[int] = None):
                 col_lens = np.where(ok, lens[np.where(ok, pos, 0)], 0).astype(
                     np.int32
                 )
-                enc = encode_fields_vectorized(combined, col_starts, col_lens)
+                enc = (
+                    encoder(combined, data, col_starts, col_lens)
+                    if encoder is not None
+                    else None
+                )
+                if enc is None:
+                    enc = encode_fields_vectorized(combined, col_starts, col_lens)
                 if enc is None:
                     raise StreamFallback("field too long for vectorized encode")
                 out[name] = enc

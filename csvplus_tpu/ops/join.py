@@ -601,17 +601,80 @@ def join_tables(
     else:  # the partitioned (multi-chip) tier answers in numpy
         probe_ids, build_ids = expand_matches(lower, counts)
 
+    build_names = list(dev_index.table.columns)
+    stream_names = list(stream.columns)
+    build_codes = tuple(
+        _aligned_codes(dev_index, n, dev_index.table.columns[n].codes, build_ids)
+        for n in build_names
+    )
+    stream_codes = tuple(stream.columns[n].codes for n in stream_names)
+
+    if _same_placement(build_codes + stream_codes):
+        # ALL row-materializing gathers in one jit call — per-column
+        # eager dispatches cost a round-trip each over tunneled backends
+        g_build, g_stream = _gather_both_sides(
+            build_codes, stream_codes, build_ids, probe_ids
+        )
+    else:
+        # mixed placements (e.g. the partitioned tier's numpy ids over a
+        # mesh-sharded stream with a single-device build table): eager
+        # per-column takes, each free to resolve its own placement
+        g_build = tuple(
+            jnp.take(c, jnp.asarray(build_ids, dtype=jnp.int32), axis=0)
+            for c in build_codes
+        )
+        g_stream = tuple(
+            jnp.take(c, jnp.asarray(probe_ids, dtype=jnp.int32), axis=0)
+            for c in stream_codes
+        )
+
     out_cols = {}
-    for name, col in dev_index.table.columns.items():
-        aligned = _aligned_codes(dev_index, name, col.codes, build_ids)
-        out_cols[name] = col.gather(build_ids, codes=aligned)
-    for name, col in stream.columns.items():  # stream wins on collision...
-        g = col.gather(probe_ids)
+    for name, codes in zip(build_names, g_build):
+        src = dev_index.table.columns[name]
+        out_cols[name] = _column_like(src, codes)
+    for name, codes in zip(stream_names, g_stream):  # stream wins on collision...
+        g = _column_like(stream.columns[name], codes)
         if name in out_cols:
             # ...but an absent stream cell keeps the index value
             g = merge_with_fallback(g, out_cols[name])
         out_cols[name] = g
     return DeviceTable(out_cols, len(probe_ids), stream.device)
+
+
+def _same_placement(arrays) -> bool:
+    """True when every array commits to the same device set (safe to
+    pass together into one jitted computation)."""
+    first = None
+    for a in arrays:
+        sh = getattr(a, "sharding", None)
+        if sh is None:
+            return False
+        ds = frozenset(sh.device_set)
+        if first is None:
+            first = ds
+        elif ds != first:
+            return False
+    return True
+
+
+@jax.jit
+def _gather_both_sides(build_codes, stream_codes, build_ids, probe_ids):
+    b_idx = jnp.asarray(build_ids, dtype=jnp.int32)
+    p_idx = jnp.asarray(probe_ids, dtype=jnp.int32)
+    return (
+        tuple(jnp.take(c, b_idx, axis=0) for c in build_codes),
+        tuple(jnp.take(c, p_idx, axis=0) for c in stream_codes),
+    )
+
+
+def _column_like(src: StringColumn, codes) -> StringColumn:
+    """A gathered column carrying *src*'s dictionary and caches (same
+    contract as StringColumn.gather, with the take done elsewhere)."""
+    out = StringColumn(src.dictionary, codes)
+    out._str_dict = src._str_dict
+    if src._has_absent is False:
+        out._has_absent = False
+    return out
 
 
 def except_mask(
