@@ -79,8 +79,7 @@ def _bench_device(data, reps: int) -> float:
 
     def once():
         t = tw.run()  # probe + gathers + compaction, columnar result
-        for c in t.columns.values():
-            c.codes.block_until_ready()
+        t.sync()  # force every output column with one scalar round trip
         return t.nrows
 
     nrows = once()  # warmup + compile
@@ -349,8 +348,7 @@ def _secondary_metrics(n_orders: int) -> None:
             # sync the ingested code arrays (async dispatch would stop the
             # clock before upload/encode completes) without materializing
             # a redundant copy of the table
-            for col in src.plan.table.columns.values():
-                col.codes.block_until_ready()
+            src.plan.table.sync()
             t_ingest = time.perf_counter() - t0
             t0 = time.perf_counter()
             idx = src.index_on("cust_id")

@@ -252,10 +252,55 @@ def _remap_concat(mappings, codes):
     return _remap_kernel(mappings, codes)
 
 
+_link_rtt_cache: "list[float]" = []
+
+
+def link_rtt_ms() -> float:
+    """Measured dispatch+sync round-trip latency to the default device,
+    in milliseconds (median of 3 tiny probes, cached per process).
+
+    A locally-attached accelerator answers in well under a millisecond;
+    a network-tunneled one takes tens to hundreds.  Tier choices that
+    trade extra device round trips for device compute key off this."""
+    if _link_rtt_cache:
+        return _link_rtt_cache[0]
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        x = jax.device_put(np.zeros(8, dtype=np.int32))
+        int(jnp.sum(x))  # warm the kernel so the probe measures RTT, not compile
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            int(jnp.sum(x))
+            samples.append((time.perf_counter() - t0) * 1000.0)
+        rtt = sorted(samples)[1]
+    except Exception:
+        rtt = 0.0  # unprobeable backend: assume local
+    _link_rtt_cache.append(rtt)
+    return rtt
+
+
+_DEVICE_PARSE_MAX_RTT_MS = 20.0
+
+
 def _device_parse_enabled() -> bool:
     """The fully-on-device parse tier: default-on when the default backend
-    is an accelerator (where the bytes would travel there anyway), opt-in
-    via CSVPLUS_DEVICE_PARSE=1 elsewhere, opt-out with =0."""
+    is a *locally attached* accelerator (where the bytes would travel
+    there anyway), opt-in via CSVPLUS_DEVICE_PARSE=1 elsewhere, opt-out
+    with =0.
+
+    Over a high-latency link (e.g. a network-tunneled chip) the device
+    encode loses by measurement: it moves the raw byte tensor plus
+    per-column offsets up and a full-length unique-rows vector down,
+    ~6x the traffic of uploading host-encoded codes, and pays several
+    dispatch round trips per column.  So when the measured link RTT
+    exceeds ``CSVPLUS_DEVICE_PARSE_MAX_RTT_MS`` (default 20ms) the
+    host-encode tiers take over unless the env flag forces otherwise."""
     import os
 
     flag = os.environ.get("CSVPLUS_DEVICE_PARSE")
@@ -263,7 +308,11 @@ def _device_parse_enabled() -> bool:
         return flag == "1"
     import jax
 
-    return jax.default_backend() not in ("cpu",)
+    if jax.default_backend() in ("cpu",):
+        return False
+    v = os.environ.get("CSVPLUS_DEVICE_PARSE_MAX_RTT_MS")
+    thresh = float(v) if v else _DEVICE_PARSE_MAX_RTT_MS
+    return link_rtt_ms() <= thresh
 
 
 def _maybe_shard(table: DeviceTable, shards, mesh) -> DeviceTable:

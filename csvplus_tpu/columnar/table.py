@@ -143,6 +143,18 @@ class StringColumn:
     def __len__(self) -> int:
         return int(self.codes.shape[0])
 
+    def with_codes(self, codes) -> "StringColumn":
+        """A column over *codes* carrying this column's dictionary and
+        caches — the single definition of what survives a row gather:
+        the decoded-dictionary cache always, and has_absent only when
+        this column is known fully present (a subset of a fully-present
+        column is fully present)."""
+        out = StringColumn(self.dictionary, codes)
+        out._str_dict = self._str_dict
+        if self._has_absent is False:
+            out._has_absent = False
+        return out
+
     def gather(self, sel, codes=None) -> "StringColumn":
         """New column of the selected row positions (device gather).
 
@@ -151,13 +163,7 @@ class StringColumn:
         and caches still come from self."""
         src = self.codes if codes is None else codes
         idx = jnp.asarray(sel, dtype=jnp.int32)
-        out = StringColumn(self.dictionary, jnp.take(src, idx, axis=0))
-        out._str_dict = self._str_dict  # dictionary unchanged; keep cache
-        if self._has_absent is False:
-            # a subset of a fully-present column is fully present; keeps
-            # downstream has_absent checks at zero device work
-            out._has_absent = False
-        return out
+        return self.with_codes(jnp.take(src, idx, axis=0))
 
     def decode(self) -> List[Optional[str]]:
         """Materialize values on host; absent cells become None."""
@@ -194,6 +200,28 @@ class StringColumn:
             jnp.take(jnp.asarray(trans_dev), jnp.clip(self.codes, 0), axis=0),
             ABSENT,
         )
+
+
+@jax.jit
+def _sync_probe(*code_arrays: jax.Array) -> jax.Array:
+    """sum(first element of each array) — a one-scalar dependency on all."""
+    return sum(a[0].astype(jnp.int32) for a in code_arrays)
+
+
+def same_placement(arrays) -> bool:
+    """True when every array commits to the same device set (safe to
+    pass together into one jitted computation)."""
+    first = None
+    for a in arrays:
+        sh = getattr(a, "sharding", None)
+        if sh is None:
+            return False
+        ds = frozenset(sh.device_set)
+        if first is None:
+            first = ds
+        elif ds != first:
+            return False
+    return True
 
 
 def merge_with_fallback(primary: StringColumn, fallback: StringColumn) -> StringColumn:
@@ -314,6 +342,28 @@ class DeviceTable:
 
     def short_desc(self) -> str:
         return f"{self.nrows}x{len(self.columns)}[{','.join(self.columns)}]"
+
+    def sync(self) -> "DeviceTable":
+        """Force completion of every column with ONE scalar round trip.
+
+        Per-column ``block_until_ready`` costs one readiness ping per
+        buffer; over a remote/tunneled backend each ping is a network
+        round trip.  Instead, dispatch a trivial reduction that depends
+        on every code array and sync its single scalar — it cannot
+        complete before all inputs have.
+        """
+        cols = [c.codes for c in self.columns.values()]
+        cols = [c for c in cols if c.shape[0]]
+        if not cols:
+            return self
+        if same_placement(cols):
+            int(_sync_probe(*cols))
+        else:
+            # mixed placements (e.g. a join of a single-device build table
+            # into a mesh-sharded stream) cannot share one jitted call
+            for c in cols:
+                c.block_until_ready()
+        return self
 
     def gather(self, sel) -> "DeviceTable":
         cols = {n: c.gather(sel) for n, c in self.columns.items()}

@@ -45,7 +45,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..columnar.table import DeviceTable, StringColumn
+from ..columnar.table import DeviceTable, StringColumn, same_placement
 
 
 def _bits_for(n: int) -> int:
@@ -609,7 +609,7 @@ def join_tables(
     )
     stream_codes = tuple(stream.columns[n].codes for n in stream_names)
 
-    if _same_placement(build_codes + stream_codes):
+    if same_placement(build_codes + stream_codes):
         # ALL row-materializing gathers in one jit call — per-column
         # eager dispatches cost a round-trip each over tunneled backends
         g_build, g_stream = _gather_both_sides(
@@ -631,30 +631,14 @@ def join_tables(
     out_cols = {}
     for name, codes in zip(build_names, g_build):
         src = dev_index.table.columns[name]
-        out_cols[name] = _column_like(src, codes)
+        out_cols[name] = src.with_codes(codes)
     for name, codes in zip(stream_names, g_stream):  # stream wins on collision...
-        g = _column_like(stream.columns[name], codes)
+        g = stream.columns[name].with_codes(codes)
         if name in out_cols:
             # ...but an absent stream cell keeps the index value
             g = merge_with_fallback(g, out_cols[name])
         out_cols[name] = g
     return DeviceTable(out_cols, len(probe_ids), stream.device)
-
-
-def _same_placement(arrays) -> bool:
-    """True when every array commits to the same device set (safe to
-    pass together into one jitted computation)."""
-    first = None
-    for a in arrays:
-        sh = getattr(a, "sharding", None)
-        if sh is None:
-            return False
-        ds = frozenset(sh.device_set)
-        if first is None:
-            first = ds
-        elif ds != first:
-            return False
-    return True
 
 
 @jax.jit
@@ -665,16 +649,6 @@ def _gather_both_sides(build_codes, stream_codes, build_ids, probe_ids):
         tuple(jnp.take(c, b_idx, axis=0) for c in build_codes),
         tuple(jnp.take(c, p_idx, axis=0) for c in stream_codes),
     )
-
-
-def _column_like(src: StringColumn, codes) -> StringColumn:
-    """A gathered column carrying *src*'s dictionary and caches (same
-    contract as StringColumn.gather, with the take done elsewhere)."""
-    out = StringColumn(src.dictionary, codes)
-    out._str_dict = src._str_dict
-    if src._has_absent is False:
-        out._has_absent = False
-    return out
 
 
 def except_mask(

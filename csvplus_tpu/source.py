@@ -452,6 +452,45 @@ class DataSource:
 
         return to_rows(self)
 
+    def to_device_table(self):
+        """Execute the pipeline into a device-resident columnar table.
+
+        The device-native terminal: runs this source's symbolic plan with
+        the device executor and returns the materialized
+        :class:`~csvplus_tpu.columnar.table.DeviceTable` — codes stay in
+        HBM, nothing is decoded to host rows (that is what
+        :meth:`to_rows` / the CSV/JSON sinks are for).  A source without
+        a device plan (or with a stage the executor cannot lower, e.g. an
+        opaque Python callback) columnarizes its streamed rows instead,
+        so the call always succeeds with reference semantics.
+        """
+        from .columnar.table import DeviceTable
+
+        device = None
+        if self.plan is not None:
+            from .columnar.exec import UnsupportedPlan, execute_plan
+
+            try:
+                table = execute_plan(self.plan)
+            except UnsupportedPlan:
+                table = None
+            if table is not None:
+                de = getattr(table, "deferred_error", None)
+                if de is not None:
+                    # a full materialization consumes every row, so a
+                    # terminal validate failure always fires (parity with
+                    # streaming the whole table)
+                    raise de[1]
+                return table
+            # fallback stays on the device the pipeline was pinned to
+            from . import plan as P
+
+            node = self.plan
+            while not isinstance(node, P.Scan):
+                node = node.child
+            device = node.table.device
+        return DeviceTable.from_rows(self.to_rows(), device=device)
+
     # -- Go-style aliases --------------------------------------------------
     Transform = transform
     Filter = filter

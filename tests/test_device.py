@@ -896,3 +896,79 @@ def test_symbolic_validate_sink_file_removed(tmp_path):
     with pytest.raises(DataSourceError):
         from_file(str(p)).on_device("cpu").validate(pred).to_csv_file(str(out), "k")
     assert not out.exists()
+
+
+def test_to_device_table_materializes_plan(tmp_path):
+    """to_device_table runs the symbolic plan on device and returns the
+    columnar result without decoding rows; decode parity with to_rows."""
+    from csvplus_tpu import Like, Take, from_file
+
+    p = tmp_path / "t.csv"
+    p.write_text("id,name\n1,a\n2,b\n3,a\n4,c\n")
+    src = from_file(str(p)).on_device("cpu").filter(Like({"name": "a"}))
+    table = src.to_device_table()
+    assert table.nrows == 2
+    host = Take(from_file(str(p))).filter(Like({"name": "a"})).to_rows()
+    assert table.to_rows() == host
+
+
+def test_to_device_table_host_source_columnarizes():
+    """A pure-host source (no plan) still materializes to a DeviceTable."""
+    from csvplus_tpu import Row, take_rows
+
+    rows = [Row({"a": "x"}), Row({"a": "y", "b": "z"})]
+    table = take_rows(rows).to_device_table()
+    assert table.nrows == 2
+    assert table.to_rows() == rows
+
+
+def test_to_device_table_opaque_callback_falls_back(tmp_path):
+    """An opaque Python filter (no symbolic form) cannot lower; the
+    materialization streams through the host path instead."""
+    from csvplus_tpu import Take, from_file
+
+    p = tmp_path / "t.csv"
+    p.write_text("id\n1\n2\n3\n")
+    src = from_file(str(p)).on_device("cpu").filter(lambda r: r["id"] != "2")
+    table = src.to_device_table()
+    assert [r["id"] for r in table.to_rows()] == ["1", "3"]
+
+
+def test_to_device_table_validate_failure_fires():
+    """A terminal symbolic validate failure fires on full materialization
+    (parity: streaming the whole table would reach the bad row)."""
+    import pytest
+
+    from csvplus_tpu import DataSourceError, Like, Row, take_rows
+
+    rows = [Row({"k": "ok"}), Row({"k": "BAD"})]
+    src = take_rows(rows).on_device("cpu").validate(Like({"k": "ok"}))
+    with pytest.raises(DataSourceError):
+        src.to_device_table()
+
+
+def test_device_table_sync_returns_self():
+    """sync() forces completion with one scalar round trip and chains."""
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    t = DeviceTable.from_pylists({"a": ["x", "y"], "b": ["1", "2"]})
+    assert t.sync() is t
+    empty = DeviceTable.from_pylists({})
+    assert empty.sync() is empty
+
+
+def test_link_rtt_probe_and_tier_gate(monkeypatch):
+    """The ingest tier gate: device parse stays off over a high-latency
+    link unless CSVPLUS_DEVICE_PARSE=1 forces it."""
+    from csvplus_tpu.columnar import ingest
+
+    monkeypatch.delenv("CSVPLUS_DEVICE_PARSE", raising=False)
+    rtt = ingest.link_rtt_ms()
+    assert rtt >= 0.0
+    monkeypatch.setattr(ingest, "_link_rtt_cache", [1000.0])
+    import jax
+
+    if jax.default_backend() != "cpu":
+        assert not ingest._device_parse_enabled()
+    monkeypatch.setenv("CSVPLUS_DEVICE_PARSE", "1")
+    assert ingest._device_parse_enabled()
