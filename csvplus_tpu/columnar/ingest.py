@@ -311,7 +311,10 @@ def _device_parse_enabled() -> bool:
     if jax.default_backend() in ("cpu",):
         return False
     v = os.environ.get("CSVPLUS_DEVICE_PARSE_MAX_RTT_MS")
-    thresh = float(v) if v else _DEVICE_PARSE_MAX_RTT_MS
+    try:
+        thresh = float(v) if v else _DEVICE_PARSE_MAX_RTT_MS
+    except ValueError:
+        thresh = _DEVICE_PARSE_MAX_RTT_MS
     return link_rtt_ms() <= thresh
 
 

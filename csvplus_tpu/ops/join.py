@@ -504,16 +504,20 @@ def _expand_kernel(lower, counts, padded_total: int):
     return probe_ids, build_ids
 
 
-def expand_matches_device(lower, counts) -> Tuple[jax.Array, jax.Array]:
+def expand_matches_device(
+    lower, counts, total: "int | None" = None
+) -> Tuple[jax.Array, jax.Array]:
     """Fan-out expansion on device; only the total (sizing the static
     output shape) crosses to host — SURVEY §7's count -> prefix-sum ->
     scatter.  The kernel compiles at the next power of two, so repeated
     joins with varying totals hit O(log n) distinct shapes, not one
-    compilation per total."""
+    compilation per total.  A caller that already synced the total (e.g.
+    join_tables' probe stats) passes it to skip the round trip."""
     if counts.shape[0] == 0:  # empty probe: nothing to expand
         empty = jnp.zeros(0, dtype=jnp.int32)
         return empty, empty
-    total = int(jnp.sum(counts))  # the one O(1) sync
+    if total is None:
+        total = int(jnp.sum(counts))  # the one O(1) sync
     padded = 1 << max(total - 1, 0).bit_length()
     probe_ids, build_ids = _expand_kernel(
         jnp.asarray(lower), jnp.asarray(counts), padded
@@ -596,8 +600,26 @@ def join_tables(
 
     probe_cols = _checked_probe_cols(stream, columns)
     lower, counts = dev_index.probe(probe_cols, stream.nrows)
+    probe_ids = build_ids = None
     if isinstance(lower, jax.Array):
-        probe_ids, build_ids = expand_matches_device(lower, counts)
+        # (total matches, max run length) in ONE host transfer; a unique
+        # build side (max run 1 — the reference's flagship shape) skips
+        # the O(n) fan-out expansion entirely
+        total, maxc = (int(v) for v in np.asarray(_probe_stats(lower, counts)))
+        if maxc <= 1 and total == stream.nrows:
+            # every stream row matched exactly once: identity on the
+            # stream side (columns pass through ungathered, caches
+            # intact), build rows addressed by the probe's lower bounds
+            build_ids = lower
+        elif maxc <= 1:
+            # unique but partial: compact the selection without the
+            # expansion scan; pow2-padded flatnonzero bounds recompiles
+            padded = 1 << max(total - 1, 0).bit_length() if total else 1
+            sel = jnp.flatnonzero(counts > 0, size=padded, fill_value=0)
+            probe_ids = sel[:total].astype(jnp.int32)
+            build_ids = jnp.take(lower, probe_ids, axis=0)
+        else:
+            probe_ids, build_ids = expand_matches_device(lower, counts, total)
     else:  # the partitioned (multi-chip) tier answers in numpy
         probe_ids, build_ids = expand_matches(lower, counts)
 
@@ -609,12 +631,23 @@ def join_tables(
     )
     stream_codes = tuple(stream.columns[n].codes for n in stream_names)
 
-    if same_placement(build_codes + stream_codes):
+    if probe_ids is None:
+        # all-matched unique fast path: stream columns pass through
+        # untouched; only the build side gathers (one jit call)
+        if same_placement(build_codes + (build_ids,)):
+            g_build = _gather_cols(build_codes, build_ids)
+        else:
+            b = jnp.asarray(build_ids, dtype=jnp.int32)
+            g_build = tuple(jnp.take(c, b, axis=0) for c in build_codes)
+        g_stream = stream_codes
+        n_out = stream.nrows
+    elif same_placement(build_codes + stream_codes):
         # ALL row-materializing gathers in one jit call — per-column
         # eager dispatches cost a round-trip each over tunneled backends
         g_build, g_stream = _gather_both_sides(
             build_codes, stream_codes, build_ids, probe_ids
         )
+        n_out = len(probe_ids)
     else:
         # mixed placements (e.g. the partitioned tier's numpy ids over a
         # mesh-sharded stream with a single-device build table): eager
@@ -627,18 +660,23 @@ def join_tables(
             jnp.take(c, jnp.asarray(probe_ids, dtype=jnp.int32), axis=0)
             for c in stream_codes
         )
+        n_out = len(probe_ids)
 
     out_cols = {}
     for name, codes in zip(build_names, g_build):
         src = dev_index.table.columns[name]
         out_cols[name] = src.with_codes(codes)
     for name, codes in zip(stream_names, g_stream):  # stream wins on collision...
-        g = stream.columns[name].with_codes(codes)
+        g = (
+            stream.columns[name]
+            if probe_ids is None
+            else stream.columns[name].with_codes(codes)
+        )
         if name in out_cols:
             # ...but an absent stream cell keeps the index value
             g = merge_with_fallback(g, out_cols[name])
         out_cols[name] = g
-    return DeviceTable(out_cols, len(probe_ids), stream.device)
+    return DeviceTable(out_cols, n_out, stream.device)
 
 
 @jax.jit
@@ -649,6 +687,20 @@ def _gather_both_sides(build_codes, stream_codes, build_ids, probe_ids):
         tuple(jnp.take(c, b_idx, axis=0) for c in build_codes),
         tuple(jnp.take(c, p_idx, axis=0) for c in stream_codes),
     )
+
+
+@jax.jit
+def _gather_cols(codes, ids):
+    idx = jnp.asarray(ids, dtype=jnp.int32)
+    return tuple(jnp.take(c, idx, axis=0) for c in codes)
+
+
+@jax.jit
+def _probe_stats(lower, counts):
+    """(total matches, max run length) as one device pair — a single
+    transfer decides the unique fast paths in :func:`join_tables`."""
+    c = counts.astype(jnp.int32)
+    return jnp.stack([jnp.sum(c), jnp.max(c) if c.shape[0] else jnp.int32(0)])
 
 
 def except_mask(
