@@ -61,7 +61,15 @@ class _View:
     stream (base 0), so its errors are numbered by streamed position.
     """
 
-    __slots__ = ("cols", "sel", "device", "full_len", "scan_base", "deferred_error")
+    __slots__ = (
+        "cols",
+        "_sel",
+        "device",
+        "full_len",
+        "scan_base",
+        "deferred_error",
+        "identity",
+    )
 
     def __init__(
         self,
@@ -70,19 +78,39 @@ class _View:
         device,
         full_len: int,
         scan_base: int = 0,
+        identity: bool = False,
     ):
         self.cols = cols
-        self.sel = sel
+        self.sel = sel  # the setter clears identity; restore from the arg
         self.device = device
         self.full_len = full_len
         self.scan_base = scan_base
+        self.identity = identity
         # (stream index of the first validate failure, the exception) —
         # fired by consumers only if streaming reaches that row
         self.deferred_error = None
 
+    @property
+    def sel(self):
+        return self._sel
+
+    @sel.setter
+    def sel(self, value):
+        # any rewrite of the selection (filter/top/drop/except/...)
+        # invalidates the identity shortcut
+        self._sel = value
+        self.identity = False
+
     def materialize(self) -> DeviceTable:
-        gathered = {n: c.gather(self.sel) for n, c in self.cols.items()}
-        table = DeviceTable(gathered, int(self.sel.shape[0]), self.device)
+        if self.identity:
+            # sel is arange(full_len) over unpadded columns: gathering
+            # would copy every column through an identity permutation
+            # (2.4GB of HBM churn at the 100M-row north star) — pass the
+            # columns through with their caches intact instead
+            table = DeviceTable(dict(self.cols), self.full_len, self.device)
+        else:
+            gathered = {n: c.gather(self.sel) for n, c in self.cols.items()}
+            table = DeviceTable(gathered, int(self.sel.shape[0]), self.device)
         if self.deferred_error is not None:
             table.deferred_error = self.deferred_error
         return table
@@ -138,6 +166,10 @@ def execute_plan_view(root: P.PlanNode) -> "_View":
         table.device,
         stored_len,
         scan_base=getattr(table, "row_base", 0),
+        # identity shortcut only for unpadded tables: padded (mesh-
+        # sharded) columns must be gathered down to nrows before any
+        # consumer sees them
+        identity=stored_len == table.nrows,
     )
 
     from ..utils.observe import telemetry
@@ -219,11 +251,15 @@ def _exec_stage(view: "_View", node: P.PlanNode) -> "_View":
             joined = J.join_tables(stream, dev_index, list(node.columns))
         except MissingColumnError as e:  # backstop; _check_key_cells covers it
             raise DataSourceError(0, e) from e
+        join_cols_len = (
+            len(next(iter(joined.columns.values()))) if joined.columns else 0
+        )
         view = _View(
             dict(joined.columns),
             jnp.arange(joined.nrows, dtype=jnp.int32),
             joined.device,
             joined.nrows,
+            identity=join_cols_len == joined.nrows,
         )
     elif isinstance(node, P.Except):
         dev_index = node.index.device_table
@@ -237,6 +273,7 @@ def _exec_stage(view: "_View", node: P.PlanNode) -> "_View":
             view.sel,
             view.device,
             view.full_len,
+            identity=view.identity,
         )
         stream = key_view.materialize()
         try:

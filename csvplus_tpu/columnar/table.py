@@ -258,6 +258,10 @@ class DeviceTable:
         self.nrows = nrows
         self.device = device
         self.row_base = row_base
+        # set by producers whose construction already blocked on a scalar
+        # that depends on every column (e.g. the fused flagship join's
+        # match count): sync() is then a completed fact, not a round trip
+        self.already_forced = False
 
     @classmethod
     def from_pylists(
@@ -352,6 +356,8 @@ class DeviceTable:
         on every code array and sync its single scalar — it cannot
         complete before all inputs have.
         """
+        if self.already_forced:
+            return self
         cols = [c.codes for c in self.columns.values()]
         cols = [c for c in cols if c.shape[0]]
         if not cols:

@@ -174,10 +174,16 @@ def distributed_sort(
     n = values.shape[0]
     if payload is None:
         payload = np.arange(n, dtype=np.int32)
+    payload = np.asarray(payload)
+    if payload.dtype != np.int32:
+        # same contract as the keys: refuse loudly rather than truncate
+        raise TypeError(
+            f"distributed_sort: int32 payload required, got {payload.dtype}"
+        )
     if n == 0:
-        return values, payload.astype(np.int32)
+        return values, payload
     x, _ = pad_to_multiple(values, n_shards, _SENT)
-    p, _ = pad_to_multiple(payload.astype(np.int32), n_shards, np.int32(-1))
+    p, _ = pad_to_multiple(payload, n_shards, np.int32(-1))
     m_per_shard = x.shape[0] // n_shards
     if capacity is None:
         # balanced routing sends ~m_per_shard/N to each destination; the
