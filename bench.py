@@ -354,6 +354,16 @@ def _secondary_metrics(n_orders: int) -> None:
             idx = src.index_on("cust_id")
             _ = len(idx)
             t_index = time.perf_counter() - t0
+            # BASELINE config 2's lookup half: point Find()s against the
+            # device index (host-mirrored key search + range decode);
+            # probe keys sampled from the generated ids so every lookup
+            # is a guaranteed hit at any row count
+            lookups = 1000
+            probes = [f"c{int(v)}" for v in ids[:lookups]]
+            t0 = time.perf_counter()
+            hits = sum(len(idx.find(p).to_rows()) > 0 for p in probes)
+            t_find = time.perf_counter() - t0
+            assert hits == len(probes)
             t0 = time.perf_counter()
             idx.resolve_duplicates("first")
             _ = len(idx)
@@ -361,6 +371,7 @@ def _secondary_metrics(n_orders: int) -> None:
         sys.stderr.write(
             f"bench[secondary]: ingest {n / t_ingest:,.0f} rows/s | "
             f"index build {n / t_index:,.0f} rows/s | "
+            f"device find {lookups / t_find:,.0f} lookups/s | "
             f"policy dedup {n / t_dedup:,.0f} rows/s (n={n})\n"
         )
     except Exception as e:  # secondary metrics must never break the line

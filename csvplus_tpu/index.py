@@ -159,7 +159,18 @@ class IndexImpl:
         if self._rows is None and self.dev is not None:
             if upper <= lower:
                 return []
-            return self.dev.table.to_rows(np.arange(lower, upper, dtype=np.int64))
+            from .ops.join import DeviceIndex
+
+            table = self.dev.table
+            # gate on total CELLS, not rows: the mirror transfers every
+            # column, so a wide table must not blow the transfer budget
+            cells = table.nrows * max(len(table.columns), 1)
+            if cells <= DeviceIndex.POINT_MIRROR_MAX_KEYS:
+                # small index: decode from host code mirrors (one O(n)
+                # transfer on the first find, then pure numpy per lookup
+                # — no device round trip)
+                return table.rows_from_mirror(lower, upper)
+            return table.to_rows(np.arange(lower, upper, dtype=np.int64))
         return self.rows[lower:upper]
 
     def has(self, values: Sequence[str]) -> bool:

@@ -214,6 +214,14 @@ class DeviceIndex:
         os.environ.get("CSVPLUS_PARTITION_MIN_KEYS", 4_000_000)
     )
 
+    # Point lookups (find/sub_index/has) mirror the sorted key array to
+    # host once, up to this many keys (64MB), and binary-search there —
+    # the reference's own O(log n) host search (csvplus.go:881-887) —
+    # instead of paying a device round trip per lookup.
+    POINT_MIRROR_MAX_KEYS: ClassVar[int] = int(
+        os.environ.get("CSVPLUS_POINT_MIRROR_MAX_KEYS", 16_000_000)
+    )
+
     @classmethod
     def build(cls, table: DeviceTable, key_columns: Sequence[str]) -> "DeviceIndex":
         key_columns = list(key_columns)
@@ -289,9 +297,37 @@ class DeviceIndex:
             qk |= code << s
         range_size = 1 << self.shifts[len(values) - 1]
         if self.packed_i32 is not None:
+            # point lookups search a lazily-mirrored HOST copy of the
+            # sorted key array: a one-time O(n) transfer, after which
+            # every find is a microsecond numpy binary search instead of
+            # a device dispatch+sync round trip per lookup (hundreds of
+            # milliseconds over a tunneled backend).  Above the size cap
+            # the mirror would cost more than it saves, so the device
+            # searchsorted remains.
+            if int(self.packed_i32.shape[0]) <= self.POINT_MIRROR_MAX_KEYS:
+                host = getattr(self, "_packed_host", None)
+                if host is None:
+                    host = self._packed_host = np.asarray(self.packed_i32)
+                # keys must match the array dtype: a python-int key makes
+                # numpy promote (copy) the whole array per lookup.  The
+                # one-past-top probe qk + range_size can equal 2^31; it
+                # then bounds nothing, so the upper is simply n.
+                lower = int(host.searchsorted(np.int32(qk), side="left"))
+                top = qk + range_size
+                if top > np.iinfo(np.int32).max:
+                    return lower, int(host.shape[0])
+                upper = int(host.searchsorted(np.int32(top), side="left"))
+                return lower, upper
+            top = qk + range_size
+            if top > np.iinfo(np.int32).max:
+                # one-past-top probe of a 31-bit universe bounds nothing
+                lower = jnp.searchsorted(
+                    self.packed_i32, jnp.int32(qk), side="left"
+                )
+                return int(lower), int(self.packed_i32.shape[0])
             res = jnp.searchsorted(
                 self.packed_i32,
-                jnp.asarray([qk, qk + range_size], dtype=jnp.int32),
+                jnp.asarray([qk, top], dtype=jnp.int32),
                 side="left",
             )
             res = np.asarray(res)

@@ -313,3 +313,27 @@ def test_direct_probe_tier_matches_searchsorted(monkeypatch):
         assert np.array_equal(np.asarray(cnt_d), np.asarray(cnt_s))
         hit = np.asarray(cnt_d) > 0
         assert np.array_equal(np.asarray(lo_d)[hit], np.asarray(lo_s)[hit])
+
+
+def test_point_bounds_host_mirror_parity(tmp_path):
+    """find/sub_index answers are identical whether point_bounds searches
+    the host mirror (small indexes) or the device array (review: the
+    mirror must include the one-past-top range probe without overflow)."""
+    from csvplus_tpu import Take, from_file
+    from csvplus_tpu.ops.join import DeviceIndex
+
+    p = tmp_path / "t.csv"
+    rows = [f"{i % 7},{i}" for i in range(40)]
+    p.write_text("k,v\n" + "\n".join(rows) + "\n")
+    idx = from_file(str(p)).on_device("cpu").index_on("k")
+    host_idx = Take(from_file(str(p))).index_on("k")
+    for probe in ["0", "3", "6", "9", ""]:
+        vals = (probe,) if probe else ()
+        assert idx._impl.bounds(vals) == host_idx._impl.bounds(vals)
+        got = [r for r in idx.find(*vals).to_rows()]
+        want = [r for r in host_idx.find(*vals).to_rows()]
+        assert got == want
+    # the highest key value exercises the one-past-top upper probe
+    ks = sorted({f"{i % 7}" for i in range(40)})
+    top = ks[-1]
+    assert idx._impl.bounds((top,)) == host_idx._impl.bounds((top,))
