@@ -173,6 +173,15 @@ def _stream_to_table(reader, path: str, device) -> DeviceTable:
         for c in names:
             d, codes = encoded[c]
             chunk_dicts[c].append(d)
+            if isinstance(codes, np.ndarray):
+                # narrow the upload to the smallest dtype the chunk's
+                # dictionary needs (codes are nonnegative slot numbers):
+                # a low-cardinality column ships 1-2 bytes/row instead
+                # of 4, and the remap gather restores int32 on device
+                if d.size <= 0xFF:
+                    codes = codes.astype(np.uint8)
+                elif d.size <= 0xFFFF:
+                    codes = codes.astype(np.uint16)
             chunk_codes[c].append(jax.device_put(codes, dev))
     if names is None:  # empty file: defer to the whole-file tiers
         from ..native.scanner import StreamFallback
@@ -183,7 +192,10 @@ def _stream_to_table(reader, path: str, device) -> DeviceTable:
     for c in names:
         dicts, codes = chunk_dicts[c], chunk_codes[c]
         if len(dicts) == 1:
-            out[c] = (dicts[0], codes[0])
+            only = codes[0]
+            if only.dtype != jnp.int32:  # narrowed upload: restore i32
+                only = only.astype(jnp.int32)
+            out[c] = (dicts[0], only)
             continue
         width = max(d.dtype.itemsize for d in dicts)
         dt = np.dtype(f"S{width}")
