@@ -15,13 +15,34 @@ What is timed:
   row-dict path per BASELINE.md: Go toolchain is not installed) running
   the same join with dict merges, timed on a subsample and scaled.
 
-Output: ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Output: ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 
-Env knobs: CSVPLUS_BENCH_ROWS (default 10_000_000 orders on an
-accelerator backend — BASELINE config 3's scale — or 2_000_000 on the
-CPU fallback),
+Reliability contract (the round-2 record was lost to a wedged
+accelerator tunnel, rc 124 — this file is structured so that can never
+happen again):
+
+1. A **global wall-clock budget** (``CSVPLUS_BENCH_BUDGET`` seconds,
+   default 540) is enforced by a watchdog thread that prints the
+   best-so-far JSON line and hard-exits at the deadline.  The deadline
+   survives the CPU-fallback re-exec via ``CSVPLUS_BENCH_DEADLINE_TS``.
+2. Backend init is guarded TWICE: a subprocess probe (a wedged tunnel
+   can hang ``jax.devices()`` indefinitely), then the main process's
+   OWN init runs on a daemon thread with a deadline — if either blows,
+   the benchmark re-execs itself into a hermetic CPU environment.
+3. The workload is **sized from the measured link** (RTT + host→device
+   bandwidth) and from a 1M-row coarse run, so a slow tunnel gets a
+   smaller tier instead of an empty record.  A coarse device number is
+   registered before the full-scale run ever starts.
+4. The headline JSON prints **immediately after** the device + host
+   measurements; the informational tiers (end-to-end, secondary, micro)
+   run afterwards, each under its own deadline, and can only add
+   stderr lines — never cost the record.
+
+Env knobs: CSVPLUS_BENCH_ROWS (override the auto-sized order count),
 CSVPLUS_BENCH_CUSTOMERS (100_000), CSVPLUS_BENCH_PRODUCTS (1_000),
-CSVPLUS_BENCH_HOST_SAMPLE (200_000), CSVPLUS_BENCH_REPS (5).
+CSVPLUS_BENCH_HOST_SAMPLE (200_000), CSVPLUS_BENCH_REPS (5),
+CSVPLUS_BENCH_BUDGET (540 s), CSVPLUS_BENCH_TIER_DEADLINE (120 s),
+CSVPLUS_BENCH_PROBE_TIMEOUT (45 s), CSVPLUS_BENCH_PROBE_RETRIES (2).
 """
 
 from __future__ import annotations
@@ -29,7 +50,176 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
+
+_METRIC = "threeway_join_rows_per_sec_chip"
+
+
+class _Recorder:
+    """Holds the best benchmark record so far; prints it exactly once.
+
+    The watchdog and the main flow race to print; the lock + flag make
+    that safe, and ``os._exit`` afterwards means a wedged backend thread
+    can never hold the process hostage past its budget."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._record: "dict | None" = None
+        self.printed = False
+
+    def register(self, record: dict) -> None:
+        with self._lock:
+            if not self.printed:
+                self._record = record
+
+    def print_once(self) -> None:
+        with self._lock:
+            if self.printed:
+                return
+            record = self._record or {
+                "metric": _METRIC,
+                "value": 0.0,
+                "unit": "rows/s",
+                "vs_baseline": 0.0,
+                "note": "watchdog fired before the first measurement",
+            }
+            print(json.dumps(record), flush=True)
+            self.printed = True
+
+
+_recorder = _Recorder()
+
+
+def _deadline_ts() -> float:
+    """The absolute wall-clock deadline, stable across the CPU re-exec."""
+    ts = os.environ.get("CSVPLUS_BENCH_DEADLINE_TS")
+    if ts:
+        try:
+            return float(ts)
+        except ValueError:
+            pass
+    budget = float(os.environ.get("CSVPLUS_BENCH_BUDGET", 540))
+    deadline = time.time() + budget
+    os.environ["CSVPLUS_BENCH_DEADLINE_TS"] = repr(deadline)
+    return deadline
+
+
+_DEADLINE = _deadline_ts()
+
+
+def _remaining() -> float:
+    return _DEADLINE - time.time()
+
+
+def _start_watchdog() -> None:
+    def watch() -> None:
+        while True:
+            rem = _remaining()
+            if rem <= 0:
+                break
+            time.sleep(min(rem, 1.0))
+        sys.stderr.write("bench: global budget exhausted; emitting best-so-far\n")
+        _recorder.print_once()
+        os._exit(0)
+
+    threading.Thread(target=watch, daemon=True, name="bench-watchdog").start()
+
+
+def _fallback_to_cpu(reason: str) -> None:
+    """Re-exec this benchmark in a hermetic CPU environment (deadline
+    preserved through the environment)."""
+    sys.stderr.write(f"bench: {reason}; falling back to CPU\n")
+    env = dict(os.environ)
+    env["CSVPLUS_BENCH_HERMETIC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _guard_backend() -> None:
+    """Two-layer guard against a wedged accelerator tunnel.
+
+    Layer 1: probe ``jax.devices()`` in a subprocess with a deadline —
+    covers a tunnel that hangs fresh client creation.  Layer 2: run the
+    main process's OWN backend init on a daemon thread with a deadline —
+    round 2's record died because the subprocess probe passed and then
+    the main process hung inside the axon client anyway (VERDICT weak
+    #1).  Either failure re-execs to CPU."""
+    import subprocess
+
+    if os.environ.get("CSVPLUS_BENCH_HERMETIC") != "1":
+        timeout = int(os.environ.get("CSVPLUS_BENCH_PROBE_TIMEOUT", 45))
+        retries = int(os.environ.get("CSVPLUS_BENCH_PROBE_RETRIES", 2))
+        ok = False
+        for attempt in range(retries):
+            try:
+                probe = subprocess.run(
+                    [sys.executable, "-c", "import jax; jax.devices()"],
+                    timeout=min(timeout, max(5, _remaining() - 60)),
+                    capture_output=True,
+                )
+                if probe.returncode == 0:
+                    ok = True
+                    break
+            except subprocess.TimeoutExpired:
+                pass
+            if attempt + 1 < retries:
+                sys.stderr.write(
+                    f"bench: backend probe {attempt + 1}/{retries} failed; retrying\n"
+                )
+                time.sleep(int(os.environ.get("CSVPLUS_BENCH_PROBE_BACKOFF", 10)))
+        if not ok:
+            _fallback_to_cpu("accelerator backend probe unreachable")
+
+    # Layer 2: the main process's own init, deadline-guarded.
+    state: dict = {}
+
+    def init() -> None:
+        try:
+            import jax
+
+            state["backend"] = jax.default_backend()
+            state["n"] = len(jax.devices())
+        except Exception as e:  # noqa: BLE001 — any init failure means CPU
+            state["error"] = repr(e)
+
+    t = threading.Thread(target=init, daemon=True, name="bench-jax-init")
+    t.start()
+    t.join(min(90, max(10, _remaining() - 90)))
+    if t.is_alive() or "error" in state:
+        why = state.get("error", "in-process backend init timed out")
+        if os.environ.get("CSVPLUS_BENCH_HERMETIC") == "1":
+            # already hermetic and still failing: emit the sentinel record
+            sys.stderr.write(f"bench: hermetic CPU init failed ({why})\n")
+            _recorder.print_once()
+            os._exit(0)
+        _fallback_to_cpu(f"main-process init failed ({why})")
+    sys.stderr.write(
+        f"bench: backend={state['backend']} devices={state['n']}"
+        f" remaining={_remaining():.0f}s\n"
+    )
+
+
+def _measure_link() -> "tuple[float, float]":
+    """(RTT ms, host→device bandwidth MB/s) for the default device.
+
+    Sizes the workload: the table build ships ~12 bytes/row of codes +
+    dictionaries, so a ~12 MB/s tunnel takes ~10 s to stage a 10M-row
+    run while a locally-attached chip takes ~0.1 s."""
+    import jax
+    import numpy as np
+
+    from csvplus_tpu.columnar.ingest import link_rtt_ms
+
+    rtt = link_rtt_ms()
+    payload = np.zeros(4 * 1024 * 1024, dtype=np.uint8)  # 4 MB
+    t0 = time.perf_counter()
+    jax.device_put(payload).block_until_ready()
+    dt = time.perf_counter() - t0
+    bw = (len(payload) / 1e6) / max(dt - rtt / 1e3, 1e-6)
+    sys.stderr.write(f"bench: link rtt={rtt:.1f}ms bw={bw:.0f}MB/s\n")
+    return rtt, bw
 
 
 def _gen_data(n_orders: int, n_cust: int, n_prod: int):
@@ -53,16 +243,16 @@ def _gen_data(n_orders: int, n_cust: int, n_prod: int):
     }
 
 
-def _bench_device(data, reps: int) -> float:
-    """Joined rows per second on the device (median over reps)."""
+def _bench_device(data, reps: int) -> "tuple[float, float]":
+    """(joined rows per second — median over reps, total wall seconds)."""
     import jax
-    import numpy as np
 
     from csvplus_tpu.columnar.table import DeviceTable
     from csvplus_tpu.models.flagship import ThreewayJoin
     from csvplus_tpu.ops.join import DeviceIndex
     from csvplus_tpu.ops.sort import sort_table
 
+    wall0 = time.perf_counter()
     dev = jax.devices()[0]
 
     def table(d):
@@ -91,7 +281,7 @@ def _bench_device(data, reps: int) -> float:
     med = sorted(times)[len(times) // 2]
     n_orders = len(next(iter(data["orders"].values())))
     assert nrows == n_orders  # all keys hit by construction
-    return n_orders / med
+    return n_orders / med, time.perf_counter() - wall0
 
 
 def _bench_host(data, sample: int) -> float:
@@ -137,74 +327,123 @@ def _bench_host(data, sample: int) -> float:
     return count / dt
 
 
-def _ensure_live_backend() -> None:
-    """Guard against a wedged accelerator tunnel: probe JAX backend init
-    in a subprocess with a deadline, retrying a few times (tunnels wedge
-    transiently); on persistent failure re-exec this benchmark in a
-    hermetic CPU environment so the driver ALWAYS gets its JSON line.
-    """
-    import subprocess
-
-    if os.environ.get("CSVPLUS_BENCH_HERMETIC") == "1":
-        return
-    timeout = int(os.environ.get("CSVPLUS_BENCH_PROBE_TIMEOUT", 120))
-    retries = int(os.environ.get("CSVPLUS_BENCH_PROBE_RETRIES", 3))
-    for attempt in range(retries):
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=timeout,
-                capture_output=True,
-            )
-            if probe.returncode == 0:
-                return  # backend healthy
-        except subprocess.TimeoutExpired:
-            pass
-        if attempt + 1 < retries:
-            sys.stderr.write(
-                f"bench: backend probe {attempt + 1}/{retries} failed; retrying\n"
-            )
-            time.sleep(int(os.environ.get("CSVPLUS_BENCH_PROBE_BACKOFF", 30)))
-    sys.stderr.write(
-        "bench: accelerator backend unreachable; falling back to CPU\n"
-    )
-    env = dict(os.environ)
-    env["CSVPLUS_BENCH_HERMETIC"] = "1"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+def _pick_full_tier(
+    backend: str, coarse_n: int, coarse_wall: float, bw_mbps: float
+) -> int:
+    """Largest order-count tier whose estimated wall time fits in just
+    over half the remaining budget.  Two estimators, take the max:
+    linear scaling of the measured coarse-run wall (captures compute +
+    staging empirically) and an explicit staging-transfer bound from the
+    measured link bandwidth (~12 bytes/row of codes; dominates on a
+    tunneled chip where the coarse run may have hit a warm cache)."""
+    tiers = [10_000_000, 5_000_000, 2_000_000] if backend != "cpu" else [2_000_000]
+    for n in tiers:
+        est_scaled = coarse_wall * (n / coarse_n) * 1.25
+        est_link = (n * 12 / 1e6) / max(bw_mbps, 0.1)
+        if max(est_scaled, est_link) <= _remaining() * 0.55:
+            return n
+    return coarse_n
 
 
 def main() -> None:
-    _ensure_live_backend()
+    _start_watchdog()
+    _guard_backend()
     import jax
 
-    # BASELINE config 3 is "10M orders"; run that scale on a real
-    # accelerator, a CPU-friendly 2M when the fallback engaged
-    default_rows = 2_000_000 if jax.default_backend() == "cpu" else 10_000_000
-    n_orders = int(os.environ.get("CSVPLUS_BENCH_ROWS", default_rows))
+    backend = jax.default_backend()
     n_cust = int(os.environ.get("CSVPLUS_BENCH_CUSTOMERS", 100_000))
     n_prod = int(os.environ.get("CSVPLUS_BENCH_PRODUCTS", 1_000))
     sample = int(os.environ.get("CSVPLUS_BENCH_HOST_SAMPLE", 200_000))
     reps = int(os.environ.get("CSVPLUS_BENCH_REPS", 5))
+    rows_override = os.environ.get("CSVPLUS_BENCH_ROWS")
 
-    data = _gen_data(n_orders, n_cust, n_prod)
-    device_rps = _bench_device(data, reps)
-    host_rps = _bench_host(data, min(sample, n_orders))
-    _end_to_end_metrics(data, n_orders)
-    _secondary_metrics(n_orders)
-    _micro_benchmarks()
+    rtt, bw = _measure_link()
 
-    print(
-        json.dumps(
-            {
-                "metric": "threeway_join_rows_per_sec_chip",
-                "value": round(device_rps, 1),
-                "unit": "rows/s",
-                "vs_baseline": round(device_rps / host_rps, 2),
-            }
-        )
+    # -- stage 1: host baseline + coarse device number (always lands) --
+    coarse_n = min(int(rows_override), 1_000_000) if rows_override else 1_000_000
+    data = _gen_data(coarse_n, n_cust, n_prod)
+    host_rps = _bench_host(data, min(sample, coarse_n))
+    _recorder.register(
+        {
+            "metric": _METRIC,
+            "value": round(host_rps, 1),
+            "unit": "rows/s",
+            "vs_baseline": 1.0,
+            "backend": "host-executor",
+            "note": "floor record: host baseline only (device not yet measured)",
+        }
     )
+    dev_rps, coarse_wall = _bench_device(data, max(2, reps // 2))
+    record = {
+        "metric": _METRIC,
+        "value": round(dev_rps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(dev_rps / host_rps, 2),
+        "backend": backend,
+        "n_orders": coarse_n,
+        "link_rtt_ms": round(rtt, 1),
+    }
+    _recorder.register(record)
+    sys.stderr.write(
+        f"bench: coarse tier n={coarse_n} -> {dev_rps:,.0f} rows/s"
+        f" ({coarse_wall:.1f}s wall, remaining={_remaining():.0f}s)\n"
+    )
+
+    # -- stage 2: full-scale tier, sized from the coarse run + link --
+    n_orders = (
+        int(rows_override) if rows_override
+        else _pick_full_tier(backend, coarse_n, coarse_wall, bw)
+    )
+    if n_orders > coarse_n:
+        data = _gen_data(n_orders, n_cust, n_prod)
+        dev_rps_full, full_wall = _bench_device(data, reps)
+        record = dict(
+            record,
+            value=round(dev_rps_full, 1),
+            vs_baseline=round(dev_rps_full / host_rps, 2),
+            n_orders=n_orders,
+        )
+        _recorder.register(record)
+        sys.stderr.write(
+            f"bench: full tier n={n_orders} -> {dev_rps_full:,.0f} rows/s"
+            f" ({full_wall:.1f}s wall)\n"
+        )
+
+    # -- the record is safe: print it NOW, tiers afterwards --
+    _recorder.print_once()
+
+    tier_deadline = float(os.environ.get("CSVPLUS_BENCH_TIER_DEADLINE", 120))
+    n = len(next(iter(data["orders"].values())))
+    ok = _run_tier("end-to-end", lambda: _end_to_end_metrics(data, n), tier_deadline)
+    ok = ok and _run_tier("secondary", lambda: _secondary_metrics(n), tier_deadline)
+    if ok:
+        _run_tier("micro", _micro_benchmarks, tier_deadline)
+    else:
+        # an abandoned tier means the backend is likely wedged (and its
+        # daemon thread still holds it); later tiers would only measure
+        # contention or block for their full deadline — skip them
+        sys.stderr.write("bench: remaining tiers skipped after an abandoned tier\n")
+    os._exit(0)  # never hang in backend teardown
+
+
+def _run_tier(name: str, fn, deadline: float) -> bool:
+    """Run an informational tier on a daemon thread with a deadline so a
+    wedged tier can only lose its own stderr line, never the record.
+    Returns False when the tier had to be abandoned."""
+    deadline = min(deadline, max(0.0, _remaining() - 10))
+    if deadline <= 1:
+        sys.stderr.write(f"bench[{name}] skipped: budget exhausted\n")
+        return True
+    t = threading.Thread(target=fn, daemon=True, name=f"bench-{name}")
+    t0 = time.perf_counter()
+    t.start()
+    t.join(deadline)
+    if t.is_alive():
+        sys.stderr.write(
+            f"bench[{name}] abandoned after {time.perf_counter() - t0:.0f}s deadline\n"
+        )
+        return False
+    return True
 
 
 def _end_to_end_metrics(data, n_orders: int) -> None:
