@@ -398,11 +398,11 @@ class DeviceIndex:
     ) -> "Tuple[jax.Array, jax.Array] | Tuple[np.ndarray, np.ndarray]":
         """(lower, counts) per probe row.
 
-        Both single-device tiers (narrow int32 and wide dual-lane)
-        answer with DEVICE arrays so the fan-out expansion and gathers
-        consume them without a host sync; only the partitioned
-        (multi-chip) tier answers in host numpy — its exchange wrapper
-        is host-orchestrated (padding, capacity retry, hot keys).
+        EVERY tier answers with DEVICE arrays so the fan-out expansion
+        and gathers consume them without an O(n) host sync — including
+        the partitioned (multi-chip) tier, whose padding, hot-key merge
+        and overflow detection run on the mesh with O(1) scalar syncs
+        (``parallel/pjoin.py`` device orchestration).
 
         Fewer probe columns than key columns = a prefix probe matching the
         whole key range under the prefix.
@@ -433,15 +433,15 @@ class DeviceIndex:
                 and len(qk_sh.device_set) > 1
                 and hasattr(qk_sh, "mesh")
             ):
-                from ..parallel.pjoin import partitioned_probe
+                from ..parallel.pjoin import partitioned_probe_device
 
-                lower, counts = partitioned_probe(
-                    qk_sh.mesh,
-                    np.asarray(qk),
-                    np.asarray(self.packed_i32),
-                    prepared=self._partitioned_for(qk_sh),
+                # device-resident end to end: the probe keys, exchange,
+                # hot-key merge and answers never leave the mesh; the
+                # only host syncs are a <=4096-element hot-key sample
+                # and one overflow boolean per capacity retry
+                return partitioned_probe_device(
+                    qk_sh.mesh, qk, self._partitioned_for(qk_sh)
                 )
-                return lower, counts
 
             if self.direct_cum is not None:
                 cum = self._lanes_for(qk, "direct_cum")
@@ -469,18 +469,14 @@ class DeviceIndex:
             and len(qk_sh.device_set) > 1
             and hasattr(qk_sh, "mesh")
         ):
-            from ..parallel.pjoin import partitioned_probe
+            from ..parallel.pjoin import partitioned_probe_device_wide
 
-            # the partitioned wrapper is host-orchestrated (padding,
-            # capacity retry, hot-key sampling), so the probe keys sync
-            # once here — two int32 lanes, the same bytes as one int64
-            qk64 = (np.asarray(q_hi).astype(np.int64) << 31) | np.asarray(q_lo)
-            qk64 = np.where(np.asarray(ok), qk64, np.int64(-1))
-            return partitioned_probe(
-                qk_sh.mesh,
-                qk64,
-                self.packed_i64,
-                prepared=self._partitioned_for(qk_sh),
+            # device-resident: invalid probes carry (-1, -1) lanes; no
+            # O(n) host sync (the lanes stay on the mesh end to end)
+            q_hi_m = jnp.where(ok, q_hi, jnp.int32(-1))
+            q_lo_m = jnp.where(ok, q_lo, jnp.int32(-1))
+            return partitioned_probe_device_wide(
+                qk_sh.mesh, q_hi_m, q_lo_m, self._partitioned_for(qk_sh)
             )
 
         range_size = 1 << range_shift
@@ -607,9 +603,17 @@ def _aligned_codes(dev_index: "DeviceIndex", name: str, codes, ids):
     hit = cache.get(name)
     if hit is not None and hit[0] == ids_sh.device_set:
         return hit[1]
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    repl = jax.device_put(codes, NamedSharding(ids_sh.mesh, P()))
+    mesh = getattr(ids_sh, "mesh", None)
+    if mesh is None:
+        # opaque (GSPMD) sharding on the ids (e.g. a jit output whose
+        # length doesn't divide the mesh): replicate onto an ad-hoc 1-D
+        # mesh over the same device set — eager ops can't mix arrays
+        # committed to different device sets
+        devs = sorted(ids_sh.device_set, key=lambda d: d.id)
+        mesh = Mesh(np.array(devs), ("r",))
+    repl = jax.device_put(codes, NamedSharding(mesh, P()))
     cache[name] = (ids_sh.device_set, repl)
     return repl
 
