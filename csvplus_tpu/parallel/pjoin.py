@@ -54,7 +54,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import pad_to_multiple, row_spec, shard_rows
+from .mesh import row_spec, shard_rows
 
 _SENTINEL = np.int32(np.iinfo(np.int32).max)
 
@@ -350,92 +350,28 @@ def partitioned_probe(
     """All-to-all partitioned probe: for every stream key, the global
     ``[lower, lower+count)`` match range in the sorted index key array.
 
-    Host-facing wrapper: pads, shards, runs the SPMD kernel, retries on
-    capacity overflow, unpads.  Keys are packed keys with -1 for invalid
-    probes (absent/unmatched dictionary translation): int32 for narrow
-    (<= 31-bit) keys, int64 for wide (<= 62-bit) keys — the wide tier
-    exchanges dual 31-bit lanes.  *prepared* short-circuits the
+    Host-facing numpy shim over the device orchestration
+    (:func:`partitioned_probe_device` / ``_wide``), which owns the
+    padding, hot-key short circuit, and capacity-retry logic — one
+    implementation, two entry points.  Keys are packed keys with -1 for
+    invalid probes (absent/unmatched dictionary translation): int32 for
+    narrow (<= 31-bit) keys, int64 for wide (<= 62-bit) keys — the wide
+    tier exchanges dual 31-bit lanes.  *prepared* short-circuits the
     partition+upload with the result of :func:`prepare_partitioned`.
     """
-    n_shards = mesh.devices.size
     wide = np.dtype(stream_keys.dtype) == np.int64
     if prepared is None:
         prepared = prepare_partitioned(mesh, index_keys_sorted)
     assert len(prepared) == (6 if wide else 4), "prepared/key dtype mismatch"
-    if not wide:
-        stream_keys = stream_keys.astype(np.int32)
-
-    # --- probe-side skew: hot-key short circuit --------------------------
-    # A heavy-hitter probe key routes its whole mass to one owner shard
-    # and inflates the slot capacity.  But a lookup answer is CONSTANT per
-    # key, so: sample the probe keys, detect heavy values, answer them
-    # once with a host binary search over the (host-resident) sorted build
-    # keys, and send only the cold keys through the exchange.
-    hot_mask = None
-    hot_lo = hot_ct = None
-    if stream_keys.size >= 4 * n_shards and index_keys_sorted.size:
-        step = max(1, stream_keys.size // 4096)
-        sample = stream_keys[::step]
-        sample = sample[sample >= 0]
-        if sample.size:
-            vals, cnts = np.unique(sample, return_counts=True)
-            # "heavy" = would overfill its owner's fair share of slots
-            thresh = max(8, sample.size // (4 * n_shards))
-            hot = vals[cnts >= thresh]
-            if hot.size:
-                h_lo = np.searchsorted(index_keys_sorted, hot, side="left")
-                h_hi = np.searchsorted(index_keys_sorted, hot, side="right")
-                idx = np.searchsorted(hot, stream_keys)
-                idx_c = np.minimum(idx, hot.size - 1)
-                hot_mask = hot[idx_c] == stream_keys
-                pos = idx_c[hot_mask]
-                hot_lo = h_lo[pos].astype(np.int32)
-                hot_ct = (h_hi - h_lo)[pos].astype(np.int32)
-                stream_keys = np.where(
-                    hot_mask, stream_keys.dtype.type(-1), stream_keys
-                )
-
-    qk, true_len = pad_to_multiple(stream_keys, n_shards, stream_keys.dtype.type(-1))
-    m_per_shard = qk.shape[0] // n_shards
-    if capacity is None:
-        # expect near-uniform routing; retry doubles on skew overflow
-        capacity = max(64, 2 * ((m_per_shard + n_shards - 1) // n_shards))
-    capacity = 1 << (int(capacity) - 1).bit_length()  # pow2 buckets limit recompiles
-
-    rows = NamedSharding(mesh, row_spec(mesh))
     if wide:
-        qh_np, ql_np = split_lanes(qk)
-        qh_dev = jax.device_put(qh_np, rows)
-        ql_dev = jax.device_put(ql_np, rows)
-        uh_dev, ul_dev, lower_dev, count_dev, sh_dev, sl_dev = prepared
+        qh, ql = split_lanes(stream_keys)
+        lo, ct = partitioned_probe_device_wide(
+            mesh, jax.device_put(qh), jax.device_put(ql), prepared, capacity
+        )
     else:
-        qk_dev = jax.device_put(qk, rows)
-        uniq_dev, lower_dev, count_dev, splits_dev = prepared
-
-    while True:
-        if wide:
-            lo, ct = _probe_spmd2(
-                mesh, n_shards, capacity,
-                qh_dev, ql_dev, uh_dev, ul_dev, lower_dev, count_dev,
-                sh_dev, sl_dev,
-            )
-        else:
-            lo, ct = _probe_spmd(
-                mesh, n_shards, capacity, qk_dev, uniq_dev, lower_dev, count_dev,
-                splits_dev,
-            )
-        ct_np = np.asarray(ct)
-        if not (ct_np < 0).any():
-            lo_np, ct_np = np.asarray(lo)[:true_len], ct_np[:true_len]
-            if hot_mask is not None:
-                lo_np = lo_np.copy()
-                ct_np = ct_np.copy()
-                lo_np[hot_mask] = np.where(hot_ct > 0, hot_lo, -1)
-                ct_np[hot_mask] = hot_ct
-            return lo_np, ct_np
-        if capacity >= qk.shape[0]:
-            raise RuntimeError("partitioned_probe: capacity overflow at maximum")
-        capacity *= 2  # residual skew: geometric retry backstop
+        qk = jax.device_put(stream_keys.astype(np.int32))
+        lo, ct = partitioned_probe_device(mesh, qk, prepared, capacity)
+    return np.asarray(lo), np.asarray(ct)
 
 
 # -- device-resident orchestration (the executor's multi-chip tier) -------
@@ -621,16 +557,19 @@ def _hot_answers_device(mesh, hot: np.ndarray, prepared, wide: bool):
         lo, ct = _probe_spmd(mesh, n_shards, cap, qk_d, uniq, lower, count, splits)
     repl = NamedSharding(mesh, P())
     # hot value lanes for the main kernel's membership search: sorted,
-    # padded with the lane maximum so padding slots never match a probe
+    # padded by REPEATING the last real value — duplicates at the tail
+    # keep the array sorted, and searchsorted-left always lands on the
+    # first (real, correctly-answered) slot, so a probe key equal to
+    # any conceivable pad value can never be answered from a pad slot
     if wide:
-        pad_hi = np.full(n_hot, np.int32((1 << 31) - 1), np.int32)
-        pad_lo = np.full(n_hot, np.int32((1 << 31) - 1), np.int32)
         hh, hl = split_lanes(hot)
+        pad_hi = np.full(n_hot, hh[-1], np.int32)
+        pad_lo = np.full(n_hot, hl[-1], np.int32)
         pad_hi[: hot.size] = hh
         pad_lo[: hot.size] = hl
         vals = (jax.device_put(pad_hi, repl), jax.device_put(pad_lo, repl))
     else:
-        pad_v = np.full(n_hot, _SENTINEL, np.int32)
+        pad_v = np.full(n_hot, hot[-1], np.int32)
         pad_v[: hot.size] = hot
         vals = (jax.device_put(pad_v, repl),)
     ans_lo = jax.device_put(jnp.asarray(lo[: hot.size]), repl)

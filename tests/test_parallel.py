@@ -840,9 +840,69 @@ def test_distributed_sort_int32_max_is_a_value(mesh):
     assert (x[perm] == vals).all()
 
 
-def test_distributed_sort_rejects_wide_dtypes(mesh):
-    """int64 packed keys must fail loudly, not truncate silently."""
+def test_distributed_sort_wide_int64(mesh):
+    """int64 (62-bit packed) keys ride the dual-lane exchange, exactly
+    like the wide join tier (VERDICT round-2 #3's done criterion)."""
     from csvplus_tpu.parallel.dsort import distributed_sort
 
+    rng = np.random.default_rng(17)
+    x = rng.integers(1 << 32, 1 << 45, size=3000).astype(np.int64)
+    vals, perm = distributed_sort(mesh, x)
+    assert (vals == np.sort(x)).all()
+    assert (x[perm] == vals).all()
+    # beyond 62 bits (or negative) still fails loudly
     with pytest.raises(TypeError):
-        distributed_sort(mesh, np.array([2**40, 1], dtype=np.int64))
+        distributed_sort(mesh, np.array([1 << 62, 1], dtype=np.int64))
+    with pytest.raises(TypeError):
+        distributed_sort(mesh, np.array([-5, 1], dtype=np.int64))
+
+
+def test_sharded_index_build_routes_dsort(people_csv, monkeypatch):
+    """A mesh-sharded table's index build sorts through the distributed
+    sample-sort — proven by the telemetry stage record — and matches the
+    host build exactly (VERDICT round-2 #3's done criterion)."""
+    import csvplus_tpu.ops.sort as S
+    from csvplus_tpu import Take, from_file
+    from csvplus_tpu.utils.observe import telemetry
+
+    monkeypatch.setattr(S, "DSORT_MIN_ROWS", 1)
+    host_idx = Take(from_file(people_csv)).index_on("surname", "name")
+    with telemetry.collect() as records:
+        dev_idx = from_file(people_csv).on_device("cpu", shards=8).index_on(
+            "surname", "name"
+        )
+        assert Take(dev_idx).to_rows() == Take(host_idx).to_rows()
+    assert any(r.stage == "dsort" for r in records)
+    # unique build over the same path
+    with telemetry.collect() as records:
+        uniq = from_file(people_csv).on_device("cpu", shards=8).unique_index_on("id")
+        assert len(uniq) == 120
+    assert any(r.stage == "dsort" for r in records)
+
+
+def test_sharded_index_build_dsort_wide_keys(monkeypatch):
+    """Composite keys past 31 packed bits sort through the dual-lane
+    distributed sample-sort on a sharded table, matching the host."""
+    import csvplus_tpu.ops.sort as S
+    from csvplus_tpu import Row, Take, TakeRows
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+    from csvplus_tpu.parallel.mesh import make_mesh
+    from csvplus_tpu.utils.observe import telemetry
+
+    monkeypatch.setattr(S, "DSORT_MIN_ROWS", 1)
+    rng = np.random.default_rng(31)
+    n = 66_000  # cardinality past 64K: each column needs 17 bits
+    rows_data = {
+        "a": [f"a{int(v):06d}" for v in rng.integers(0, n, n // 10)],
+        "b": [f"b{int(v):06d}" for v in rng.integers(0, n, n // 10)],
+    }
+    host_rows = [Row({"a": x, "b": y}) for x, y in zip(rows_data["a"], rows_data["b"])]
+    host_idx = TakeRows(host_rows).index_on("a", "b")
+    table = DeviceTable.from_pylists(rows_data, device="cpu").with_sharding(
+        make_mesh(8)
+    )
+    with telemetry.collect() as records:
+        dev_idx = source_from_table(table).index_on("a", "b")
+        assert Take(dev_idx).to_rows() == Take(host_idx).to_rows()
+    assert any(r.stage == "dsort" for r in records)
