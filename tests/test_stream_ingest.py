@@ -74,10 +74,48 @@ def test_stream_field_count_error_absolute_rows(tmp_path):
     assert ei.value.line == 102  # header=1, 100 good rows, bad=102
 
 
-def test_stream_quotes_fall_back(tmp_path):
+@pytest.mark.parametrize("chunk", [8, 17, 64, 1 << 20])
+def test_stream_quoted_matches_reader(tmp_path, chunk):
+    """Quoted fields stream chunk-by-chunk (VERDICT round-2 #4): embedded
+    delimiters, embedded NEWLINES (the chunk-boundary hazard), and
+    escaped quotes all match the whole-file reader at every chunk size."""
+    text = (
+        "id,txt,qty\n"
+        + "".join(
+            f'r{i},"v,{i}\nline2-{i}",{i % 7}\n'
+            if i % 3 == 0
+            else f'r{i},"say ""hi"" {i}",{i % 7}\n'
+            if i % 3 == 1
+            else f"r{i},plain{i},{i % 7}\n"
+            for i in range(120)
+        )
+    )
+    path = _write(tmp_path, text)
+    names, cols, total = _collect(from_file(path), path, chunk)
+    want_names, want = from_file(path).read_columns()
+    assert names == want_names
+    assert total == 120
+    assert cols == want
+
+
+def test_stream_quoted_field_larger_than_chunk(tmp_path):
+    """One quoted field bigger than the whole chunk size: the parity cut
+    finds no safe newline and grows the pending buffer until the field
+    closes — content parity preserved."""
+    big = "x," * 80  # 160 bytes of embedded delimiters
+    text = f'a,b\n"{big}",1\nplain,2\n'
+    path = _write(tmp_path, text)
+    names, cols, total = _collect(from_file(path), path, 16)
+    want_names, want = from_file(path).read_columns()
+    assert total == 2 and cols == want
+
+
+def test_stream_lazy_quotes_fall_back(tmp_path):
+    """LazyQuotes + quote bytes keep the whole-file scanner: a bare
+    quote inside an unquoted field would break the parity invariant."""
     path = _write(tmp_path, 'a,b\n"q,uoted",2\n')
     with pytest.raises(native.StreamFallback):
-        _collect(from_file(path), path, 8)
+        _collect(from_file(path).lazy_quotes(), path, 8)
 
 
 def test_stream_long_field_falls_back(tmp_path):
@@ -121,6 +159,25 @@ def test_stream_end_to_end_pipeline(tmp_path, monkeypatch):
     monkeypatch.setenv("CSVPLUS_STREAM_CHUNK_BYTES", "64")
     text = "id,grp,qty\n" + "".join(
         f"r{i},g{i % 5},{i % 9}\n" for i in range(300)
+    )
+    path = _write(tmp_path, text)
+    with telemetry.collect() as records:
+        rows = from_file(path).on_device().to_rows()
+    want = Take(from_file(path)).to_rows()
+    assert rows == want
+    assert any(r.stage == "ingest:streamed" for r in records)
+
+
+def test_stream_quoted_end_to_end_pipeline(tmp_path, monkeypatch):
+    """A QUOTED file through from_file().on_device(): the streamed tier
+    engages (telemetry pin) and the pipeline matches the host oracle."""
+    from csvplus_tpu import Take
+    from csvplus_tpu.utils.observe import telemetry
+
+    monkeypatch.setenv("CSVPLUS_STREAM_MIN_BYTES", "1")
+    monkeypatch.setenv("CSVPLUS_STREAM_CHUNK_BYTES", "96")
+    text = "id,txt,qty\n" + "".join(
+        f'r{i},"t,{i}\nnl{i}",{i % 9}\n' for i in range(150)
     )
     path = _write(tmp_path, text)
     with telemetry.collect() as records:
