@@ -20,7 +20,6 @@ strings are only materialized back on the host at the sink boundary.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -93,15 +92,93 @@ def lookup_code(dictionary: np.ndarray, value: str) -> int:
     return -1
 
 
-@dataclass
 class StringColumn:
-    """One dictionary-encoded string column."""
+    """One dictionary-encoded string column.
 
-    dictionary: np.ndarray  # sorted unique values (UTF-8 'S' bytes), host
-    codes: jax.Array  # int32[n] on device; -1 = absent cell
-    _has_absent: "bool | None" = None  # lazy cache: any absent cells?
-    _str_dict: "np.ndarray | None" = None  # lazy cache: decoded dictionary
-    _codes_host: "np.ndarray | None" = None  # lazy cache: host code mirror
+    The dictionary normally lives on host (sorted 'S' bytes).  HIGH-
+    CARDINALITY columns may instead carry it on DEVICE as sign-flipped
+    int32 byte lanes (ops/lanes.py) with ``dictionary=None``: host RSS
+    then stays bounded through ingest and through every code-only
+    operation (sorts, filters, joins via lane translation).  Reading
+    ``.dictionary`` on such a column lazily downloads and unpacks the
+    lanes — the sink-boundary cost, paid only when strings are actually
+    materialized.
+    """
+
+    def __init__(
+        self,
+        dictionary: "np.ndarray | None",  # sorted 'S' bytes, host (or None)
+        codes: jax.Array,  # int32[n] on device; -1 = absent cell
+        _has_absent: "bool | None" = None,  # lazy cache: any absent cells?
+        _str_dict: "np.ndarray | None" = None,  # lazy cache: decoded dict
+        _codes_host: "np.ndarray | None" = None,  # lazy cache: host codes
+        dev_dictionary: "tuple | None" = None,  # sorted int32 lanes, device
+    ):
+        assert dictionary is not None or dev_dictionary is not None
+        self._dictionary = dictionary
+        self.codes = codes
+        self._has_absent = _has_absent
+        self._str_dict = _str_dict
+        self._codes_host = _codes_host
+        self.dev_dictionary = dev_dictionary
+
+    @property
+    def dictionary(self) -> np.ndarray:
+        """The host dictionary — lazily materialized (download + unpack)
+        for device-lane columns, then cached."""
+        if self._dictionary is None:
+            from ..ops.lanes import unpack_host
+
+            self._dictionary = unpack_host(
+                [np.asarray(l) for l in self.dev_dictionary]
+            )
+        return self._dictionary
+
+    @property
+    def dict_size(self) -> int:
+        """Distinct-value count WITHOUT forcing host materialization."""
+        if self._dictionary is not None:
+            return int(self._dictionary.size)
+        return int(self.dev_dictionary[0].shape[0])
+
+    def dict_lanes(self) -> "tuple":
+        """The dictionary as device lanes (packed+uploaded on demand for
+        host-dictionary columns; identity for device-lane columns)."""
+        if self.dev_dictionary is not None:
+            return self.dev_dictionary
+        from ..ops.lanes import lanes_for_width, pack_host
+
+        width = self._dictionary.dtype.itemsize if self._dictionary.size else 1
+        lanes = lanes_for_width(width)
+        if lanes is None:
+            raise ValueError("dictionary too wide for lane packing")
+        return tuple(
+            jax.device_put(l) for l in pack_host(self._dictionary, lanes)
+        )
+
+    def find_code(self, value: str) -> int:
+        """Dictionary slot of *value* or -1 — the device lane search for
+        lane columns (search + verification fused in one jitted kernel,
+        ONE scalar sync, no dictionary download), the host binary search
+        otherwise."""
+        if self._dictionary is not None:
+            return lookup_code(self._dictionary, value)
+        from ..ops.lanes import (
+            MAX_LANE_BYTES,
+            lanes_for_width,
+            pack_host,
+            translate_lanes,
+        )
+
+        key = value.encode("utf-8")
+        if len(key) > MAX_LANE_BYTES:
+            return -1  # wider than any lane-dictionary entry can be
+        n_lanes = len(self.dev_dictionary)
+        if lanes_for_width(len(key)) > n_lanes:
+            return -1  # longer than every stored entry: cannot match
+        q = pack_host(np.array([key], dtype="S"), n_lanes)
+        qs = tuple(jnp.asarray(l) for l in q)
+        return int(translate_lanes(self.dev_dictionary, qs)[0])
 
     @property
     def has_absent(self) -> bool:
@@ -161,7 +238,9 @@ class StringColumn:
         the decoded-dictionary cache always, and has_absent only when
         this column is known fully present (a subset of a fully-present
         column is fully present)."""
-        out = StringColumn(self.dictionary, codes)
+        out = StringColumn(
+            self._dictionary, codes, dev_dictionary=self.dev_dictionary
+        )
         out._str_dict = self._str_dict
         if self._has_absent is False:
             out._has_absent = False
@@ -182,7 +261,7 @@ class StringColumn:
         absent cells (negative codes, incl. the -2 sharding pad) become
         None.  The single definition of host-side code decoding, shared
         by :meth:`decode` and :meth:`DeviceTable.rows_from_mirror`."""
-        if self.dictionary.size == 0:
+        if self.dict_size == 0:
             return [None] * codes.shape[0]
         d = self.dictionary_str()
         vals = d[np.clip(codes, 0, d.size - 1)]
@@ -194,6 +273,24 @@ class StringColumn:
     def decode(self) -> List[Optional[str]]:
         """Materialize values on host; absent cells become None."""
         return self.decode_codes(np.asarray(self.codes))
+
+    def renumbered_to_col(self, other: "StringColumn") -> jax.Array:
+        """Translate this column's codes into *other*'s code space —
+        the device lane translation when either side keeps its
+        dictionary on device (no host materialization), the host
+        translation-table path otherwise."""
+        if self.dev_dictionary is None and other.dev_dictionary is None:
+            return self.renumbered_to(other.dictionary)
+        from ..ops.lanes import translate_lanes
+
+        if self.dict_size == 0:
+            return self.codes
+        trans = translate_lanes(other.dict_lanes(), self.dict_lanes())
+        return jnp.where(
+            self.codes >= 0,
+            jnp.take(trans, jnp.clip(self.codes, 0), axis=0),
+            ABSENT,
+        )
 
     def renumbered_to(self, other_dictionary: np.ndarray) -> jax.Array:
         """Translate this column's codes into another dictionary's code
@@ -301,15 +398,20 @@ class DeviceTable:
         device=None,
     ) -> "DeviceTable":
         """Build from already dictionary-encoded columns
-        ((dictionary, codes) pairs, e.g. the native ingest fast path)."""
+        ((dictionary, codes) pairs, e.g. the native ingest fast path;
+        a ready StringColumn — e.g. a device-lane-dictionary column from
+        the streamed ingest — passes through unchanged)."""
         dev = default_device(device)
-        cols = {
-            name: StringColumn(
+        cols = {}
+        for name, value in data.items():
+            if isinstance(value, StringColumn):
+                cols[name] = value
+                continue
+            dictionary, codes = value
+            cols[name] = StringColumn(
                 dictionary,
                 codes if isinstance(codes, jax.Array) else jax.device_put(codes, dev),
             )
-            for name, (dictionary, codes) in data.items()
-        }
         return cls(cols, nrows, dev)
 
     @classmethod
@@ -356,7 +458,11 @@ class DeviceTable:
                 codes = np.concatenate(
                     [codes, np.full(pad, -2, dtype=np.int32)]
                 )
-            moved = StringColumn(col.dictionary, jax.device_put(codes, sharding))
+            moved = StringColumn(
+                col._dictionary,
+                jax.device_put(codes, sharding),
+                dev_dictionary=col.dev_dictionary,
+            )
             moved._str_dict = col._str_dict
             moved._has_absent = col._has_absent if not pad else None
             cols[name] = moved

@@ -131,11 +131,11 @@ class ThreewayJoin:
         prod_col: str = "prod_id",
     ) -> "ThreewayJoin":
         assert len(cust_index.key_columns) == 1 and len(prod_index.key_columns) == 1
-        qk_c = orders.columns[cust_col].renumbered_to(
-            cust_index.table.columns[cust_index.key_columns[0]].dictionary
+        qk_c = orders.columns[cust_col].renumbered_to_col(
+            cust_index.table.columns[cust_index.key_columns[0]]
         )
-        qk_p = orders.columns[prod_col].renumbered_to(
-            prod_index.table.columns[prod_index.key_columns[0]].dictionary
+        qk_p = orders.columns[prod_col].renumbered_to_col(
+            prod_index.table.columns[prod_index.key_columns[0]]
         )
         return cls(
             cust=cust_index,
@@ -266,11 +266,11 @@ class ThreewayJoin:
 
         out: Dict[str, StringColumn] = {}
         for name, codes in zip(names_c, g_c):
-            out[name] = StringColumn(self.cust.table.columns[name].dictionary, codes)
+            out[name] = self.cust.table.columns[name].with_codes(codes)
         for name, codes in zip(names_p, g_p):
-            out[name] = StringColumn(self.prod.table.columns[name].dictionary, codes)
+            out[name] = self.prod.table.columns[name].with_codes(codes)
         for name, codes in zip(names_o, g_o):  # stream wins
-            out[name] = StringColumn(self.orders_cols[name].dictionary, codes)
+            out[name] = self.orders_cols[name].with_codes(codes)
         device = next(iter(out.values())).codes.device if out else None
         table = DeviceTable(out, n_out, device)
         if direct and unpadded and n_valid == self.n_orders:

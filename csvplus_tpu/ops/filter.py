@@ -20,7 +20,7 @@ from typing import Dict
 import jax.numpy as jnp
 
 from ..predicates import All, Any_, Like, Not
-from ..columnar.table import StringColumn, lookup_code
+from ..columnar.table import StringColumn
 
 
 class UnsupportedPredicate(Exception):
@@ -79,7 +79,7 @@ def _equality_terms(cols, preds):
         if col not in cols:
             continue
         c = cols[col]
-        code = lookup_code(c.dictionary, val)
+        code = c.find_code(val)
         if code < 0:
             continue
         terms.append((c.codes, code))
@@ -94,7 +94,7 @@ def build_mask(cols: Dict[str, StringColumn], nrows: int, pred) -> jnp.ndarray:
             if col not in cols:
                 return jnp.zeros(nrows, dtype=bool)
             c = cols[col]
-            code = lookup_code(c.dictionary, val)
+            code = c.find_code(val)
             if code < 0:
                 return jnp.zeros(nrows, dtype=bool)
             terms.append((c.codes, code))

@@ -226,7 +226,7 @@ class DeviceIndex:
     def build(cls, table: DeviceTable, key_columns: Sequence[str]) -> "DeviceIndex":
         key_columns = list(key_columns)
         cols = [table.columns[c] for c in key_columns]
-        bits = [_bits_for(c.dictionary.size) for c in cols]
+        bits = [_bits_for(c.dict_size) for c in cols]
         total = sum(bits)
         if total > 62:
             return cls(table, key_columns, None, None, None)
@@ -287,11 +287,9 @@ class DeviceIndex:
         assert self.supported
         if not values:
             return 0, self.table.nrows
-        from ..columnar.table import lookup_code
-
         qk = 0
         for v, name, s in zip(values, self.key_columns, self.shifts):
-            code = lookup_code(self.table.columns[name].dictionary, v)
+            code = self.table.columns[name].find_code(v)
             if code < 0:
                 return 0, 0  # value not in the index at all
             qk |= code << s
@@ -390,7 +388,7 @@ class DeviceIndex:
         """Per-column probe codes translated into the build dictionaries."""
         out = []
         for pc, ic_name in zip(probe_cols, self.key_columns[:n_key_cols]):
-            out.append(pc.renumbered_to(self.table.columns[ic_name].dictionary))
+            out.append(pc.renumbered_to_col(self.table.columns[ic_name]))
         return out
 
     def probe(

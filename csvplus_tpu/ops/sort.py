@@ -63,7 +63,7 @@ def _packed_sort_lanes(key_cols) -> "Optional[Tuple[jax.Array, ...]]":
     lexicographic code order the replicated sort produces."""
     from .join import _bits_for, pack_lanes
 
-    bits = [_bits_for(c.dictionary.size) for c in key_cols]
+    bits = [_bits_for(c.dict_size) for c in key_cols]
     total = sum(bits)
     if total > 62:
         return None
@@ -119,7 +119,7 @@ def sort_table(table: DeviceTable, key_columns: Sequence[str]) -> DeviceTable:
     for name, col in table.columns.items():
         if name in sorted_keys:
             # key columns come out of the sort already permuted
-            out[name] = StringColumn(col.dictionary, sorted_keys[name])
+            out[name] = col.with_codes(sorted_keys[name])
         else:
             out[name] = col.gather(perm)
     return DeviceTable(out, table.nrows, table.device)

@@ -64,8 +64,6 @@ except ImportError:  # pragma: no cover - older jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import row_spec
-
-_SENT = np.int32(np.iinfo(np.int32).max)
 _MASK31 = np.int32((1 << 31) - 1)
 
 

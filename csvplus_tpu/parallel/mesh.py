@@ -58,12 +58,3 @@ def replicate(mesh: Mesh, x: "jax.Array | np.ndarray") -> jax.Array:
     """Place *x* fully replicated over the mesh."""
     return jax.device_put(x, NamedSharding(mesh, P()))
 
-
-def pad_to_multiple(x: np.ndarray, n: int, fill) -> "tuple[np.ndarray, int]":
-    """Pad dim 0 up to a multiple of *n*; returns (padded, original_len)."""
-    m = x.shape[0]
-    rem = (-m) % n
-    if rem == 0:
-        return x, m
-    pad = np.full((rem,) + x.shape[1:], fill, dtype=x.dtype)
-    return np.concatenate([x, pad]), m

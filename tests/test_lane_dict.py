@@ -1,0 +1,176 @@
+"""Device-lane dictionaries for high-cardinality columns (ops/lanes.py +
+the streamed ingest switch): bounded host RSS with full pipeline parity
+(VERDICT round-2 weak #5 / next-round #5).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from csvplus_tpu import Like, Take, from_file
+from csvplus_tpu.ops import lanes as L
+
+
+def _rand_dict(rng, n, width=12):
+    vals = set()
+    while len(vals) < n:
+        vals.add(
+            "".join(chr(rng.integers(33, 127)) for _ in range(rng.integers(1, width)))
+        )
+    return np.sort(np.array([v.encode() for v in vals], dtype="S"))
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(5)
+    d = _rand_dict(rng, 300)
+    lanes = L.lanes_for_width(d.dtype.itemsize)
+    packed = L.pack_host(d, lanes)
+    back = L.unpack_host(packed)
+    assert (back.astype(d.dtype) == d).all()
+    # packed lane order (lexicographic over sign-flipped lanes) == byte order
+    key = [tuple(int(l[i]) for l in packed) for i in range(d.size)]
+    assert key == sorted(key)
+
+
+def test_searchsorted_lanes_differential():
+    rng = np.random.default_rng(7)
+    d = _rand_dict(rng, 500)
+    lanes = L.lanes_for_width(d.dtype.itemsize)
+    keys = tuple(jnp.asarray(l) for l in L.pack_host(d, lanes))
+    probes = np.concatenate([d[::3], _rand_dict(rng, 100, 10)])
+    q = tuple(jnp.asarray(l) for l in L.pack_host(probes.astype(d.dtype), lanes))
+    got = np.asarray(L.searchsorted_lanes(keys, q))
+    want = np.searchsorted(d, probes.astype(d.dtype))
+    assert (got == want).all()
+
+
+def test_union_device_differential():
+    rng = np.random.default_rng(9)
+    chunks = [_rand_dict(rng, n) for n in (40, 200, 7, 130)]
+    width = max(c.dtype.itemsize for c in chunks)
+    lane_sets = [
+        tuple(
+            jnp.asarray(l)
+            for l in L.pack_host(c.astype(f"S{width}"), L.lanes_for_width(width))
+        )
+        for c in chunks
+    ]
+    union_lanes, tables = L.union_device(lane_sets)
+    union = L.unpack_host([np.asarray(l) for l in union_lanes])
+    want = np.unique(np.concatenate([c.astype(f"S{width}") for c in chunks]))
+    assert (union.astype(want.dtype) == want).all()
+    for c, t in zip(chunks, tables):
+        got = union[np.asarray(t)].astype(want.dtype)
+        assert (got == c.astype(want.dtype)).all()
+
+
+def test_translate_lanes_mixed_widths():
+    rng = np.random.default_rng(11)
+    build = _rand_dict(rng, 300, width=20)  # wider: more lanes
+    query = _rand_dict(rng, 80, width=6)  # narrower: fewer lanes
+    bl = tuple(
+        jnp.asarray(l)
+        for l in L.pack_host(build, L.lanes_for_width(build.dtype.itemsize))
+    )
+    ql = tuple(
+        jnp.asarray(l)
+        for l in L.pack_host(query, L.lanes_for_width(query.dtype.itemsize))
+    )
+    trans = np.asarray(L.translate_lanes(bl, ql))
+    wide = f"S{max(build.dtype.itemsize, query.dtype.itemsize)}"
+    for q, t in zip(query.astype(wide), trans):
+        if t >= 0:
+            assert build.astype(wide)[t] == q
+        else:
+            assert q not in build.astype(wide)
+
+
+@pytest.fixture
+def highcard_csv(tmp_path, monkeypatch):
+    """A CSV whose order_id is unique per row; env tuned so the streamed
+    tier engages with tiny chunks and the lane switch fires immediately."""
+    monkeypatch.setenv("CSVPLUS_STREAM_MIN_BYTES", "1")
+    monkeypatch.setenv("CSVPLUS_STREAM_CHUNK_BYTES", "512")
+    monkeypatch.setenv("CSVPLUS_DICT_DEVICE_MIN_DISTINCT", "1")
+    p = tmp_path / "orders.csv"
+    p.write_text(
+        "order_id,cust,qty\n"
+        + "".join(f"ord-{i:06d},c{i % 9},{i % 5}\n" for i in range(400))
+    )
+    return str(p)
+
+
+def test_streamed_highcard_column_stays_on_device(highcard_csv):
+    """After ingest the unique column's dictionary lives ON DEVICE (host
+    copy never built); decoding at the sink materializes it lazily and
+    matches the host oracle byte for byte."""
+    from csvplus_tpu.columnar.exec import execute_plan
+    from csvplus_tpu.utils.observe import telemetry
+
+    with telemetry.collect() as records:
+        dev = from_file(highcard_csv).on_device()
+        table = execute_plan(dev.plan)
+    assert any(r.stage == "ingest:streamed" for r in records)
+    col = table.columns["order_id"]
+    assert col.dev_dictionary is not None
+    assert col._dictionary is None  # the RSS bound: no host dictionary
+    assert col.dict_size == 400  # distinct count without materializing
+    rows = dev.to_rows()
+    want = Take(from_file(highcard_csv)).to_rows()
+    assert rows == want
+
+
+def test_threshold_splits_columns_by_cardinality(tmp_path, monkeypatch):
+    """With a mid-range threshold only the high-cardinality column
+    switches to device lanes; low-cardinality columns keep host dicts."""
+    from csvplus_tpu.columnar.exec import execute_plan
+
+    monkeypatch.setenv("CSVPLUS_STREAM_MIN_BYTES", "1")
+    monkeypatch.setenv("CSVPLUS_STREAM_CHUNK_BYTES", "512")
+    monkeypatch.setenv("CSVPLUS_DICT_DEVICE_MIN_DISTINCT", "100")
+    p = tmp_path / "o.csv"
+    p.write_text(
+        "order_id,cust,qty\n"
+        + "".join(f"ord-{i:06d},c{i % 9},{i % 5}\n" for i in range(400))
+    )
+    table = execute_plan(from_file(str(p)).on_device().plan)
+    assert table.columns["order_id"].dev_dictionary is not None
+    assert table.columns["cust"].dev_dictionary is None
+    assert table.columns["cust"]._dictionary is not None
+    rows_dev = from_file(str(p)).on_device().to_rows()
+    assert rows_dev == Take(from_file(str(p))).to_rows()
+
+
+def test_highcard_filter_and_find(highcard_csv):
+    """Equality filters and point lookups on a lane-dictionary column
+    run without downloading the dictionary (find_code device search)."""
+    dev = from_file(highcard_csv).on_device()
+    got = dev.filter(Like({"order_id": "ord-000123"})).to_rows()
+    want = (
+        Take(from_file(highcard_csv))
+        .filter(Like({"order_id": "ord-000123"}))
+        .to_rows()
+    )
+    assert got == want and len(got) == 1
+    # a value that cannot exist
+    assert dev.filter(Like({"order_id": "zzz"})).to_rows() == []
+
+
+def test_highcard_index_and_join(highcard_csv, tmp_path):
+    """IndexOn/UniqueIndexOn/Find and a JOIN keyed on the high-
+    cardinality column run via lane translation, matching the host."""
+    idx = from_file(highcard_csv).on_device().unique_index_on("order_id")
+    host_idx = Take(from_file(highcard_csv)).unique_index_on("order_id")
+    assert len(idx) == 400
+    assert idx.find("ord-000007").to_rows() == host_idx.find("ord-000007").to_rows()
+
+    p2 = tmp_path / "notes.csv"
+    p2.write_text(
+        "order_id,note\n"
+        + "".join(f"ord-{i:06d},n{i}\n" for i in range(0, 400, 7))
+    )
+    host = Take(from_file(p2)).join(host_idx, "order_id").to_rows()
+    dev = from_file(str(p2)).on_device().join(idx, "order_id").to_rows()
+    assert dev == host and len(host) == len(range(0, 400, 7))

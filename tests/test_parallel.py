@@ -893,15 +893,19 @@ def test_sharded_index_build_dsort_wide_keys(monkeypatch):
     monkeypatch.setattr(S, "DSORT_MIN_ROWS", 1)
     rng = np.random.default_rng(31)
     n = 66_000  # cardinality past 64K: each column needs 17 bits
+    perm = rng.permutation(n)
     rows_data = {
-        "a": [f"a{int(v):06d}" for v in rng.integers(0, n, n // 10)],
-        "b": [f"b{int(v):06d}" for v in rng.integers(0, n, n // 10)],
+        "a": [f"a{int(v):06d}" for v in perm],  # all n values: 17 bits
+        "b": [f"b{int((v * 7) % n):06d}" for v in perm],
     }
     host_rows = [Row({"a": x, "b": y}) for x, y in zip(rows_data["a"], rows_data["b"])]
     host_idx = TakeRows(host_rows).index_on("a", "b")
     table = DeviceTable.from_pylists(rows_data, device="cpu").with_sharding(
         make_mesh(8)
     )
+    # the packed key must overflow one int32 lane -> dual-lane dsort tier
+    key_cols = [table.columns["a"], table.columns["b"]]
+    assert len(S._packed_sort_lanes(key_cols)) == 2
     with telemetry.collect() as records:
         dev_idx = source_from_table(table).index_on("a", "b")
         assert Take(dev_idx).to_rows() == Take(host_idx).to_rows()
