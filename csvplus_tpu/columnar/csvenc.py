@@ -55,9 +55,10 @@ def _escape_dictionary(d_str: np.ndarray, delimiter: str = ",") -> np.ndarray:
 def encode_json_body(table: DeviceTable) -> Optional[str]:
     """The JSON array body (between the brackets), byte-identical to the
     streaming sink (sorted keys, compact separators, newline per object,
-    comma-separated); None when any column has absent cells (rows then
-    differ in schema, so the streaming path handles them)."""
-    import json
+    Go string escaping per csvplus.go:456's ``SetEscapeHTML(false)``);
+    None when any column has absent cells (rows then differ in schema,
+    so the streaming path handles them)."""
+    from ..utils.gojson import go_json_string
 
     names = sorted(table.columns)
     cols = []
@@ -76,11 +77,11 @@ def encode_json_body(table: DeviceTable) -> Optional[str]:
     for i, (name, col) in enumerate(zip(names, cols)):
         d = col.dictionary_str()
         enc = np.asarray(
-            [json.dumps(v, ensure_ascii=False) for v in d.tolist()],
+            [go_json_string(v) for v in d.tolist()],
             dtype=np.str_,
         )
         vals = enc[np.asarray(col.codes)]
-        prefix = ("{" if i == 0 else ",") + json.dumps(name, ensure_ascii=False) + ":"
+        prefix = ("{" if i == 0 else ",") + go_json_string(name) + ":"
         piece = np.char.add(prefix, vals)
         line = piece if line is None else np.char.add(line, piece)
     line = np.char.add(line, "}")

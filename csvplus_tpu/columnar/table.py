@@ -141,21 +141,6 @@ class StringColumn:
             return int(self._dictionary.size)
         return int(self.dev_dictionary[0].shape[0])
 
-    def dict_lanes(self) -> "tuple":
-        """The dictionary as device lanes (packed+uploaded on demand for
-        host-dictionary columns; identity for device-lane columns)."""
-        if self.dev_dictionary is not None:
-            return self.dev_dictionary
-        from ..ops.lanes import lanes_for_width, pack_host
-
-        width = self._dictionary.dtype.itemsize if self._dictionary.size else 1
-        lanes = lanes_for_width(width)
-        if lanes is None:
-            raise ValueError("dictionary too wide for lane packing")
-        return tuple(
-            jax.device_put(l) for l in pack_host(self._dictionary, lanes)
-        )
-
     def find_code(self, value: str) -> int:
         """Dictionary slot of *value* or -1 — the device lane search for
         lane columns (search + verification fused in one jitted kernel,
@@ -274,18 +259,64 @@ class StringColumn:
         """Materialize values on host; absent cells become None."""
         return self.decode_codes(np.asarray(self.codes))
 
+    def _lanes_narrow(self) -> "tuple":
+        """``(lane tuple, original-slot positions | None)`` — this
+        dictionary as device lanes, restricted to entries narrow enough
+        to lane-pack.  A host dictionary mixed into a lane-column join
+        may hold values wider than MAX_LANE_BYTES; those can never equal
+        any lane entry, so they are excluded here (positions returned so
+        the caller can remap subset slots back to full slots) instead of
+        failing the whole join."""
+        if self.dev_dictionary is not None:
+            return self.dev_dictionary, None
+        from ..ops.lanes import MAX_LANE_BYTES, lanes_for_width, pack_host
+
+        d = self._dictionary
+        width = d.dtype.itemsize if d.size else 1
+        lanes = lanes_for_width(width)
+        if lanes is not None:
+            return tuple(jax.device_put(l) for l in pack_host(d, lanes)), None
+        if d.dtype.kind != "S":
+            d = d.astype("S")
+        keep = np.char.str_len(d) <= MAX_LANE_BYTES
+        pos = np.flatnonzero(keep).astype(np.int32)
+        sub = d[keep].astype(f"S{MAX_LANE_BYTES}")
+        lanes = lanes_for_width(MAX_LANE_BYTES)
+        return tuple(jax.device_put(l) for l in pack_host(sub, lanes)), pos
+
     def renumbered_to_col(self, other: "StringColumn") -> jax.Array:
         """Translate this column's codes into *other*'s code space —
         the device lane translation when either side keeps its
         dictionary on device (no host materialization), the host
-        translation-table path otherwise."""
+        translation-table path otherwise.  Host dictionaries with
+        entries wider than a lane can hold are handled by translating
+        the narrow subset and treating wide values as no-match."""
         if self.dev_dictionary is None and other.dev_dictionary is None:
             return self.renumbered_to(other.dictionary)
         from ..ops.lanes import translate_lanes
 
         if self.dict_size == 0:
             return self.codes
-        trans = translate_lanes(other.dict_lanes(), self.dict_lanes())
+        q_lanes, q_pos = self._lanes_narrow()
+        b_lanes, b_pos = other._lanes_narrow()
+        if b_lanes[0].shape[0] == 0 or q_lanes[0].shape[0] == 0:
+            return jnp.full_like(self.codes, ABSENT)
+        trans = translate_lanes(b_lanes, q_lanes)
+        if b_pos is not None:
+            # subset slots of other -> other's full code space
+            trans = jnp.where(
+                trans >= 0,
+                jnp.take(jnp.asarray(b_pos), jnp.clip(trans, 0), axis=0),
+                -1,
+            )
+        if q_pos is not None:
+            # scatter subset results back over self's full dictionary;
+            # wide entries stay -1 (no-match)
+            trans = (
+                jnp.full(self.dict_size, -1, jnp.int32)
+                .at[jnp.asarray(q_pos)]
+                .set(trans)
+            )
         return jnp.where(
             self.codes >= 0,
             jnp.take(trans, jnp.clip(self.codes, 0), axis=0),

@@ -219,4 +219,33 @@ int64_t csv_count_bounds(const char* buf, int64_t len, char delim,
   return 0;
 }
 
+// Gather n (start, len) fields into NUL-padded fixed-width rows of
+// `width` bytes — the dictionary-encode pre-pass.  Replaces a numpy
+// index-matrix gather that allocated an (n, width) int64 index array;
+// here it is one memcpy+memset per field.  Caller guarantees
+// lens[i] <= width and starts[i] + lens[i] <= buffer length.
+void csv_pack_fields(const char* buf, const int64_t* starts,
+                     const int32_t* lens, int64_t n, int32_t width,
+                     char* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    char* dst = out + i * (int64_t)width;
+    int32_t l = lens[i];
+    memcpy(dst, buf + starts[i], (size_t)l);
+    memset(dst + l, 0, (size_t)(width - l));
+  }
+}
+
+// Same gather for fields of <= 8 bytes, packed big-endian (first byte
+// most significant, NUL padding in the low bytes) straight into native
+// uint64 values: integer order == byte order, and np.unique on a
+// native scalar dtype is the fastest encode sort available.
+void csv_pack_fields_u64(const char* buf, const int64_t* starts,
+                         const int32_t* lens, int64_t n, uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t v = 0;
+    memcpy(&v, buf + starts[i], (size_t)lens[i]);
+    out[i] = __builtin_bswap64(v);
+  }
+}
+
 }  // extern "C"

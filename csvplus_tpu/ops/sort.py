@@ -50,7 +50,10 @@ def _sharded_mesh(key_cols) -> "Optional[object]":
             return None
         if mesh is None:
             mesh = m
-        elif m.devices.size != mesh.devices.size:
+        elif m is not mesh and m != mesh:
+            # same device count over DIFFERENT meshes (devices, shape or
+            # axis names) would run the sample-sort with the wrong
+            # placement (ADVICE r3); Mesh.__eq__ covers all three
             return None
     return mesh
 

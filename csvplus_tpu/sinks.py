@@ -14,12 +14,12 @@ both paths.
 
 from __future__ import annotations
 
-import json
 import os
 from typing import IO, List
 
 from .csvio import write_record
 from .row import Row
+from .utils.gojson import go_json_object
 
 
 def to_csv(src, out: IO[str], *columns: str) -> None:
@@ -71,9 +71,14 @@ def to_csv_file(src, name: str, *columns: str) -> None:
 def to_json(src, out: IO[str]) -> None:
     """Stream rows as a JSON array of objects (csvplus.go:446-475).
 
-    Matches the reference's byte format: Go's ``json.Encoder`` emits each
-    object compactly with **sorted keys**, followed by a newline; objects
-    are comma-separated inside ``[...]`` and flushed in ~10KB batches.
+    Matches the reference's byte format exactly: Go's ``json.Encoder``
+    emits each object compactly with **sorted keys**, followed by a
+    newline; objects are comma-separated inside ``[...]`` and flushed in
+    ~10KB batches.  The reference sets ``SetEscapeHTML(false)``
+    (csvplus.go:456), so ``&<>`` pass through unescaped; Go's remaining
+    escaping rules (``\\u0008``/``\\u000c`` for backspace/form-feed,
+    always-escaped U+2028/U+2029) are reproduced by
+    :func:`csvplus_tpu.utils.gojson.go_json_object`.
     """
     if getattr(src, "plan", None) is not None:
         from .columnar.csvenc import encode_json_body
@@ -102,10 +107,7 @@ def to_json(src, out: IO[str]) -> None:
         if count != 1:
             buf.append(",")
             buf_len += 1
-        s = (
-            json.dumps(row, sort_keys=True, separators=(",", ":"), ensure_ascii=False)
-            + "\n"
-        )
+        s = go_json_object(row) + "\n"
         buf.append(s)
         buf_len += len(s)
         if buf_len > 10000:

@@ -13,9 +13,15 @@ both executors can produce cheaply:
   so checksumming the full 100M-row result costs two ops per column
   and syncs one scalar.
 
-The sum is order-independent; row ORDER is covered separately by the
-row-count assert plus the host-executor comparison on a deterministic
-prefix slice (both executors emit stream order, csvplus.go:552-568).
+With ``positional=True`` each row's hash is multiplied by the odd
+weight ``2*i + 1`` (i = row position) before summing, making the sum
+ORDER-SENSITIVE: a row permutation or cross-row cell swap between rows
+holding different values changes the column sum with high probability
+(a swap of rows i,j survives only when ``(h_i - h_j)*(j - i) == 0 mod
+2^31`` — the usual 32-bit-checksum collision odds, not a guarantee).
+The north-star parity check uses positional sums so stream order
+(csvplus.go:552-568) is covered by the checksum itself, not just by
+spot rows.
 """
 
 from __future__ import annotations
@@ -49,9 +55,12 @@ def fnv1a_values(values: np.ndarray) -> np.ndarray:
     return h
 
 
-def checksum_host_rows(rows: Sequence, columns: Sequence[str]) -> Dict[str, int]:
+def checksum_host_rows(
+    rows: Sequence, columns: Sequence[str], positional: bool = False
+) -> Dict[str, int]:
     """Per-column row-hash sums (mod 2^32) for host Row dicts; an absent
-    cell contributes 0."""
+    cell contributes 0.  ``positional=True`` makes the sum
+    order-sensitive (see module docstring)."""
     out = {}
     for c in columns:
         vals = [r.get(c) for r in rows]
@@ -60,29 +69,80 @@ def checksum_host_rows(rows: Sequence, columns: Sequence[str]) -> Dict[str, int]
         if present.any():
             arr = np.array([v for v in vals if v is not None], dtype=np.str_)
             hashes[present] = fnv1a_values(arr)
+        if positional and hashes.size:
+            with np.errstate(over="ignore"):
+                hashes = hashes * (
+                    2 * np.arange(hashes.size, dtype=np.uint32) + np.uint32(1)
+                )
         out[c] = int(np.add.reduce(hashes, dtype=np.uint32))
     return out
 
 
+def fnv1a_lanes_device(lane_arrays):
+    """32-bit FNV-1a per dictionary entry, computed ON DEVICE from the
+    sign-flipped int32 lane packing (ops/lanes.py) — no dictionary
+    download, so checksumming a device-lane column preserves its
+    bounded-host-RSS contract (ADVICE r3).  Byte-for-byte identical to
+    :func:`fnv1a_values` on the unpacked dictionary: bytes are extracted
+    big-endian per lane word, trailing NULs excluded via a per-entry
+    last-nonzero-byte length."""
+    import jax.numpy as jnp
+
+    from ..ops.lanes import _SIGN
+
+    n = lane_arrays[0].shape[0]
+    if n == 0:
+        return jnp.empty(0, dtype=jnp.uint32)
+    # bytes[i][pos] for pos = 4*lane + shift, big-endian within the word
+    byte_cols = []
+    for lane in lane_arrays:
+        word = (jnp.asarray(lane) ^ jnp.int32(_SIGN)).astype(jnp.uint32)
+        for shift in (24, 16, 8, 0):
+            byte_cols.append((word >> shift) & jnp.uint32(0xFF))
+    # value length = last non-NUL byte position + 1 (pack_host pads with
+    # NULs; np.char.str_len strips exactly the trailing ones)
+    length = jnp.zeros(n, dtype=jnp.int32)
+    for pos, b in enumerate(byte_cols):
+        length = jnp.maximum(length, jnp.where(b != 0, pos + 1, 0))
+    h = jnp.full(n, jnp.uint32(2166136261))
+    for pos, b in enumerate(byte_cols):
+        nh = (h ^ b) * jnp.uint32(16777619)
+        h = jnp.where(pos < length, nh, h)
+    return h
+
+
 def checksum_device_table(
-    table, columns: Optional[Sequence[str]] = None, limit: Optional[int] = None
+    table,
+    columns: Optional[Sequence[str]] = None,
+    limit: Optional[int] = None,
+    positional: bool = False,
 ) -> Dict[str, int]:
     """Per-column row-hash sums (mod 2^32) of a DeviceTable, computed on
     device: dictionary hashes upload once per column (each distinct
     value hashed once on host), then one gather + one reduce per column
-    and a single scalar sync for the whole table."""
+    and a single scalar sync for the whole table.  Device-lane columns
+    hash their packed lanes on device instead (no host download).
+    ``positional=True`` makes the sums order-sensitive."""
     import jax
     import jax.numpy as jnp
 
     names = list(columns) if columns is not None else list(table.columns)
     n = table.nrows if limit is None else min(limit, table.nrows)
+    weights = (
+        2 * jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(1) if positional else None
+    )
     sums = []
     for c in names:
         col = table.columns[c]
-        htab = jax.device_put(fnv1a_values(col.dictionary).astype(jnp.uint32))
+        if getattr(col, "dev_dictionary", None) is not None and col._dictionary is None:
+            htab = fnv1a_lanes_device(col.dev_dictionary)
+        else:
+            htab = jax.device_put(fnv1a_values(col.dictionary).astype(jnp.uint32))
         codes = col.codes[:n]
         gathered = jnp.take(htab, jnp.clip(codes, 0), axis=0)
         gathered = jnp.where(codes >= 0, gathered, jnp.uint32(0))
+        if weights is not None:
+            gathered = gathered * weights
         sums.append(jnp.sum(gathered, dtype=jnp.uint32))
     stacked = np.asarray(jnp.stack(sums)) if sums else np.empty(0, np.uint32)
     return {c: int(v) for c, v in zip(names, stacked)}

@@ -144,10 +144,13 @@ def main() -> None:
     # FULL-RESULT verification (BASELINE: "identical output rows"):
     # 1. exact result row count (asserted above: table.nrows == n_orders)
     # 2. the HOST EXECUTOR runs the same pipeline on a deterministic
-    #    >=1M-row prefix slice and its per-column row-hash sums must
-    #    equal the device result's checksums over the same slice —
-    #    every column of every slice row verified, not a sampled head
-    # 3. per-column checksums over ALL result rows, computed on device
+    #    >=1M-row prefix slice and its POSITIONAL per-column row-hash
+    #    sums must equal the device result's over the same slice — the
+    #    position-weighted sums are order-sensitive, so a permutation
+    #    or cross-row cell swap inside the prefix fails the check with
+    #    ordinary 32-bit-checksum confidence (ADVICE r3), on top of
+    #    every cell value being covered
+    # 3. positional checksums over ALL result rows, computed on device
     #    (one gather + reduce per column) and recorded in the JSON so
     #    independent runs/backends can be compared bit-for-bit
     from csvplus_tpu import StopPipeline, take_rows
@@ -174,8 +177,8 @@ def main() -> None:
     t0 = time.perf_counter()
     host_rows = take_rows(head).Join(h_cust, "cust_id").Join(h_prod).to_rows()
     cols = sorted(table.columns)
-    want_sums = checksum_host_rows(host_rows, cols)
-    got_sums = checksum_device_table(table, cols, limit=sample)
+    want_sums = checksum_host_rows(host_rows, cols, positional=True)
+    got_sums = checksum_device_table(table, cols, limit=sample, positional=True)
     assert got_sums == want_sums, (
         f"checksum mismatch on the first {sample} rows: "
         f"{got_sums} != {want_sums}"
@@ -189,7 +192,7 @@ def main() -> None:
         f"the host executor exactly ({t_verify:,.1f}s)",
         file=sys.stderr,
     )
-    full_sums = checksum_device_table(table, cols)
+    full_sums = checksum_device_table(table, cols, positional=True)
     print(f"full-result column checksums ({table.nrows:,} rows): {full_sums}",
           file=sys.stderr)
 

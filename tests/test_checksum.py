@@ -70,3 +70,69 @@ def test_checksum_absent_cells():
     assert checksum_device_table(t, ["a", "b"]) == checksum_host_rows(
         rows, ["a", "b"]
     )
+
+
+def test_fnv1a_lanes_device_matches_host():
+    """Device-lane FNV (no dictionary download) is byte-identical to the
+    host hash of the unpacked dictionary, across widths incl. the
+    32-byte lane cap (ADVICE r3: the full-table checksum must not
+    reinstate O(distinct) host RSS for lane columns)."""
+    import numpy as np
+
+    from csvplus_tpu.ops.lanes import lanes_for_width, pack_host
+    from csvplus_tpu.utils.checksum import fnv1a_lanes_device, fnv1a_values
+
+    rng = np.random.default_rng(13)
+    vals = set()
+    while len(vals) < 400:
+        w = int(rng.integers(1, 33))
+        vals.add("".join(chr(rng.integers(33, 127)) for _ in range(w)))
+    d = np.sort(np.array([v.encode() for v in vals], dtype="S"))
+    lanes = pack_host(d, lanes_for_width(d.dtype.itemsize))
+    got = np.asarray(fnv1a_lanes_device(lanes))
+    want = fnv1a_values(d)
+    assert (got == want).all()
+
+
+def test_checksum_lane_column_no_host_materialization(tmp_path, monkeypatch):
+    """checksum_device_table on a lane column hashes on device and does
+    NOT populate the host dictionary cache."""
+    monkeypatch.setenv("CSVPLUS_STREAM_MIN_BYTES", "1")
+    monkeypatch.setenv("CSVPLUS_STREAM_CHUNK_BYTES", "512")
+    monkeypatch.setenv("CSVPLUS_DICT_DEVICE_MIN_DISTINCT", "1")
+    from csvplus_tpu import Take, from_file
+    from csvplus_tpu.columnar.exec import execute_plan
+    from csvplus_tpu.utils.checksum import checksum_device_table, checksum_host_rows
+
+    p = tmp_path / "o.csv"
+    p.write_text(
+        "order_id,qty\n" + "".join(f"ord-{i:05d},{i % 7}\n" for i in range(300))
+    )
+    table = execute_plan(from_file(str(p)).on_device().plan)
+    col = table.columns["order_id"]
+    assert col.dev_dictionary is not None and col._dictionary is None
+    got = checksum_device_table(table, ["order_id", "qty"])
+    assert col._dictionary is None  # the checksum did not download it
+    want = checksum_host_rows(Take(from_file(str(p))).to_rows(), ["order_id", "qty"])
+    assert got == want
+
+
+def test_positional_checksum_detects_row_permutation():
+    """Order-independent sums pass under a prefix permutation; the
+    positional sums used by the north-star parity check must fail it
+    (ADVICE r3)."""
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    rows = [Row({"a": f"v{i}"}) for i in range(64)]
+    swapped = list(rows)
+    swapped[3], swapped[40] = swapped[40], swapped[3]
+    base = checksum_host_rows(rows, ["a"])
+    assert checksum_host_rows(swapped, ["a"]) == base  # blind without position
+    pos = checksum_host_rows(rows, ["a"], positional=True)
+    assert checksum_host_rows(swapped, ["a"], positional=True) != pos
+    t = DeviceTable.from_rows(rows, device="cpu")
+    assert checksum_device_table(t, ["a"], positional=True) == pos
+    # limit= prefix agrees with the host prefix, positionally
+    assert checksum_device_table(t, ["a"], limit=10, positional=True) == (
+        checksum_host_rows(rows[:10], ["a"], positional=True)
+    )

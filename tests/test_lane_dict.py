@@ -174,3 +174,27 @@ def test_highcard_index_and_join(highcard_csv, tmp_path):
     host = Take(from_file(p2)).join(host_idx, "order_id").to_rows()
     dev = from_file(str(p2)).on_device().join(idx, "order_id").to_rows()
     assert dev == host and len(host) == len(range(0, 400, 7))
+
+
+def test_wide_probe_values_against_lane_index(highcard_csv, tmp_path):
+    """A join keyed on a lane column must not crash when the probe side's
+    host dictionary holds values wider than MAX_LANE_BYTES (ADVICE r3
+    medium): wide values are unmatchable, everything else still joins."""
+    idx = from_file(highcard_csv).on_device().unique_index_on("order_id")
+    host_idx = Take(from_file(highcard_csv)).unique_index_on("order_id")
+
+    wide = "W" * 48  # > MAX_LANE_BYTES: can never match a lane entry
+    p2 = tmp_path / "notes.csv"
+    p2.write_text(
+        "order_id,note\n"
+        + "".join(f"ord-{i:06d},n{i}\n" for i in range(0, 400, 7))
+        + f"{wide},wide1\n"
+        + f"{'X' * 33},wide2\n"
+    )
+    host = Take(from_file(str(p2))).join(host_idx, "order_id").to_rows()
+    dev = from_file(str(p2)).on_device().join(idx, "order_id").to_rows()
+    assert dev == host and len(host) == len(range(0, 400, 7))
+    # and the anti-join keeps exactly the wide (unmatchable) rows
+    host_x = Take(from_file(str(p2))).except_(host_idx, "order_id").to_rows()
+    dev_x = from_file(str(p2)).on_device().except_(idx, "order_id").to_rows()
+    assert dev_x == host_x and len(dev_x) == 2

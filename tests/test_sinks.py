@@ -62,6 +62,60 @@ def test_to_json_empty():
     assert buf.getvalue() == "[]"
 
 
+# Adversarial values and the exact bytes Go's encoder emits for them.
+# The reference sets SetEscapeHTML(false) (csvplus.go:456), so &<> pass
+# through UNescaped; Go still escapes backspace/form-feed as \\u0008 /
+# \\u000c (where Python would use \b / \f), always escapes U+2028/U+2029,
+# and uses the \n \r \t shorthands plus lowercase \u00xx for the rest.
+_GO_JSON_CASES = [
+    ("a&b<c>d", '"a&b<c>d"'),
+    ('q"uo\\te', '"q\\"uo\\\\te"'),
+    ("tab\there", '"tab\\there"'),
+    ("nl\nrc\r", '"nl\\nrc\\r"'),
+    ("bs\x08ff\x0c", '"bs\\u0008ff\\u000c"'),
+    ("ctl\x01\x1f", '"ctl\\u0001\\u001f"'),
+    ("ls ps ", '"ls\\u2028ps\\u2029"'),
+    ("unicode→é", '"unicode→é"'),
+]
+
+
+def test_to_json_go_escaping_bytes():
+    """Streaming sink byte parity with Go's encoder on adversarial values
+    (csvplus.go:446-475 with SetEscapeHTML(false) at :456)."""
+    for raw, want in _GO_JSON_CASES:
+        buf = io.StringIO()
+        TakeRows([Row({"k": raw})]).to_json(buf)
+        assert buf.getvalue() == '[{"k":%s}\n]' % want, raw
+    # escaping applies to keys too
+    buf = io.StringIO()
+    TakeRows([Row({"a&b\x08": "v"})]).to_json(buf)
+    assert buf.getvalue() == '[{"a&b\\u0008":"v"}\n]'
+
+
+def test_to_json_go_escaping_device_path():
+    """The vectorized device-table JSON encoder emits the same bytes as
+    the streaming sink for every adversarial value."""
+    from csvplus_tpu.columnar.table import DeviceTable
+    from csvplus_tpu.columnar.csvenc import encode_json_body
+
+    rows = [Row({"k": raw}) for raw, _ in _GO_JSON_CASES]
+    want = io.StringIO()
+    TakeRows(rows).to_json(want)
+    table = DeviceTable.from_rows(rows, device="cpu")
+    body = encode_json_body(table)
+    assert body is not None
+    assert "[" + body + "]" == want.getvalue()
+
+
+def test_row_str_matches_go_raw_concatenation():
+    """Row.__str__ parity: the reference's Row.String (csvplus.go:90-104)
+    is RAW byte concatenation — no %q escaping — so quote-bearing values
+    embed literally.  Pin that exact behavior."""
+    r = Row({"b": 'va"lue', "a": "x\ty"})
+    assert str(r) == '{ "a" : "x\ty", "b" : "va"lue" }'
+    assert str(Row({})) == "{}"
+
+
 def test_json_struct_roundtrip(people_csv, corpus):
     """ToJSON then decode and compare with the oracle (TestJSONStruct)."""
     buf = io.StringIO()
