@@ -17,32 +17,48 @@ What is timed:
 
 Output: ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 
-Reliability contract (the round-2 record was lost to a wedged
-accelerator tunnel, rc 124 — this file is structured so that can never
+Reliability contract (round 1 fell back to CPU silently, round 2 lost
+its record to a wedged tunnel at rc 124, round 3 gave up on the tunnel
+110s into a 540s budget — this file is structured so none of those can
 happen again):
 
-1. A **global wall-clock budget** (``CSVPLUS_BENCH_BUDGET`` seconds,
+1. **Record-CPU-first** (VERDICT r3 next #1): the un-instrumented main
+   process first runs the whole benchmark hermetically on CPU in a
+   subprocess and registers that record as the FLOOR.  Only then does
+   it touch the accelerator: it re-probes ``jax.devices()`` in
+   subprocesses with backoff until ~150s of budget remain, and if the
+   tunnel ever answers it re-execs onto the accelerator (floor carried
+   in the environment).  Every probe's stderr is captured and logged;
+   a never-reachable tunnel yields the CPU record with the actual
+   probe error text in ``probe_error``.
+2. A **global wall-clock budget** (``CSVPLUS_BENCH_BUDGET`` seconds,
    default 540) is enforced by a watchdog thread that prints the
    best-so-far JSON line and hard-exits at the deadline.  The deadline
-   survives the CPU-fallback re-exec via ``CSVPLUS_BENCH_DEADLINE_TS``.
-2. Backend init is guarded TWICE: a subprocess probe (a wedged tunnel
-   can hang ``jax.devices()`` indefinitely), then the main process's
-   OWN init runs on a daemon thread with a deadline — if either blows,
-   the benchmark re-execs itself into a hermetic CPU environment.
-3. The workload is **sized from the measured link** (RTT + host→device
+   survives every re-exec via ``CSVPLUS_BENCH_DEADLINE_TS``.
+3. On the accelerator, the main process's OWN backend init runs on a
+   daemon thread with a deadline (a probe can pass and the in-process
+   client still hang); failure re-execs to hermetic CPU.
+4. The workload is **sized from the measured link** (RTT + host→device
    bandwidth) and from a 1M-row coarse run, so a slow tunnel gets a
    smaller tier instead of an empty record.  A coarse device number is
    registered before the full-scale run ever starts.
-4. The headline JSON prints **immediately after** the device + host
+5. The headline JSON prints **immediately after** the device + host
    measurements; the informational tiers (end-to-end, secondary, micro)
    run afterwards, each under its own deadline, and can only add
    stderr lines — never cost the record.
+
+Baseline honesty (VERDICT r3 next #6): ``vs_baseline`` is explicitly
+labeled ``baseline_kind: python_host_executor`` (Go is not installed),
+and the record also carries ``go_class_proxy_rows_per_sec`` /
+``vs_go_class_proxy`` — a compiled C++ re-creation of the reference's
+exact hot-loop shape (bench_oracle.cpp) bounding the Go-class multiple.
 
 Env knobs: CSVPLUS_BENCH_ROWS (override the auto-sized order count),
 CSVPLUS_BENCH_CUSTOMERS (100_000), CSVPLUS_BENCH_PRODUCTS (1_000),
 CSVPLUS_BENCH_HOST_SAMPLE (200_000), CSVPLUS_BENCH_REPS (5),
 CSVPLUS_BENCH_BUDGET (540 s), CSVPLUS_BENCH_TIER_DEADLINE (120 s),
-CSVPLUS_BENCH_PROBE_TIMEOUT (45 s), CSVPLUS_BENCH_PROBE_RETRIES (2).
+CSVPLUS_BENCH_PROBE_BACKOFF (20 s), CSVPLUS_BENCH_GO_PROXY (=0 skips
+the C++ proxy).
 """
 
 from __future__ import annotations
@@ -66,12 +82,21 @@ class _Recorder:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._record: "dict | None" = None
+        self._floor: "dict | None" = None
         self.printed = False
 
     def register(self, record: dict) -> None:
         with self._lock:
             if not self.printed:
                 self._record = record
+
+    def register_floor(self, record: dict) -> None:
+        """A record that can only be REPLACED by a better value — the
+        CPU floor: a degraded-tunnel device measurement below it must
+        not win the printed line."""
+        with self._lock:
+            if not self.printed:
+                self._floor = record
 
     def print_once(self) -> None:
         with self._lock:
@@ -84,6 +109,19 @@ class _Recorder:
                 "vs_baseline": 0.0,
                 "note": "watchdog fired before the first measurement",
             }
+            if self._floor is not None and self._floor.get(
+                "value", 0
+            ) > record.get("value", 0):
+                record = dict(
+                    self._floor,
+                    note="CPU floor beat the accelerator measurement"
+                    + (
+                        f" ({record.get('value')} rows/s on "
+                        f"{record.get('backend')})"
+                        if record.get("value")
+                        else ""
+                    ),
+                )
             print(json.dumps(record), flush=True)
             self.printed = True
 
@@ -137,42 +175,39 @@ def _fallback_to_cpu(reason: str) -> None:
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
-def _guard_backend() -> None:
-    """Two-layer guard against a wedged accelerator tunnel.
-
-    Layer 1: probe ``jax.devices()`` in a subprocess with a deadline —
-    covers a tunnel that hangs fresh client creation.  Layer 2: run the
-    main process's OWN backend init on a daemon thread with a deadline —
-    round 2's record died because the subprocess probe passed and then
-    the main process hung inside the axon client anyway (VERDICT weak
-    #1).  Either failure re-execs to CPU."""
+def _probe_backend(timeout: float) -> "tuple[bool, str]":
+    """One subprocess probe of ``jax.devices()``; (ok, stderr tail).
+    The stderr is captured and RETURNED (round-3 weak #1: a discarded
+    probe stderr made a dead tunnel indistinguishable from a cold
+    start)."""
     import subprocess
 
-    if os.environ.get("CSVPLUS_BENCH_HERMETIC") != "1":
-        timeout = int(os.environ.get("CSVPLUS_BENCH_PROBE_TIMEOUT", 45))
-        retries = int(os.environ.get("CSVPLUS_BENCH_PROBE_RETRIES", 2))
-        ok = False
-        for attempt in range(retries):
-            try:
-                probe = subprocess.run(
-                    [sys.executable, "-c", "import jax; jax.devices()"],
-                    timeout=min(timeout, max(5, _remaining() - 60)),
-                    capture_output=True,
-                )
-                if probe.returncode == 0:
-                    ok = True
-                    break
-            except subprocess.TimeoutExpired:
-                pass
-            if attempt + 1 < retries:
-                sys.stderr.write(
-                    f"bench: backend probe {attempt + 1}/{retries} failed; retrying\n"
-                )
-                time.sleep(int(os.environ.get("CSVPLUS_BENCH_PROBE_BACKOFF", 10)))
-        if not ok:
-            _fallback_to_cpu("accelerator backend probe unreachable")
+    probe_src = (
+        "import sys, jax\n"
+        "ds = jax.devices()\n"
+        "if not any(d.platform != 'cpu' for d in ds):\n"
+        "    sys.stderr.write('only CPU devices visible: %r\\n' % (ds,))\n"
+        "    sys.exit(7)\n"
+    )
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", probe_src],
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+        )
+        if probe.returncode == 0:
+            return True, ""
+        return False, (probe.stderr or "")[-500:]
+    except subprocess.TimeoutExpired as e:
+        tail = (e.stderr.decode() if isinstance(e.stderr, bytes) else e.stderr) or ""
+        return False, f"probe timed out after {timeout:.0f}s; stderr: {tail[-400:]}"
 
-    # Layer 2: the main process's own init, deadline-guarded.
+
+def _guard_backend() -> None:
+    """In-process backend init, deadline-guarded (layer 2 of the round-3
+    guard): round 2's record died because a subprocess probe passed and
+    the main process then hung inside the axon client anyway."""
     state: dict = {}
 
     def init() -> None:
@@ -345,8 +380,176 @@ def _pick_full_tier(
     return coarse_n
 
 
+def _go_class_proxy(data) -> "float | None":
+    """rows/s of the reference's 3-way join loop shape in compiled C++
+    (bench_oracle.cpp: sorted-vector binary searches + per-row hash-map
+    merges — the Go map[string]string performance class), bounding the
+    honest "vs Go" multiple where no Go toolchain exists (VERDICT r3
+    missing #4).  None when the toolchain or run fails."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("CSVPLUS_BENCH_GO_PROXY") == "0":
+        return None
+    try:
+        import numpy as np
+
+        src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_oracle.cpp")
+        with tempfile.TemporaryDirectory() as td:
+            # compile into the run-private dir: a fixed world-shared path
+            # could execute another user's binary or race a concurrent run
+            exe = os.path.join(td, "bench_oracle")
+            subprocess.run(
+                ["g++", "-O2", "-o", exe, src], check=True, capture_output=True,
+                timeout=60,
+            )
+            o, c, p = data["orders"], data["customers"], data["products"]
+            n = len(o["cust_id"])
+            cap = min(n, 1_000_000)  # the proxy loop is O(n log n); cap it
+            with open(f"{td}/orders.csv", "w") as f:
+                f.write("cust_id,prod_id,qty\n")
+                body = np.char.add(
+                    np.char.add(np.char.add(o["cust_id"][:cap], ","),
+                                np.char.add(o["prod_id"][:cap], ",")),
+                    o["qty"][:cap],
+                )
+                f.write("\n".join(body.tolist()) + "\n")
+            with open(f"{td}/customers.csv", "w") as f:
+                f.write("id,name\n")
+                f.write("\n".join(np.char.add(np.char.add(c["id"], ","), c["name"]).tolist()) + "\n")
+            with open(f"{td}/products.csv", "w") as f:
+                f.write("prod_id,product,price\n")
+                body = np.char.add(
+                    np.char.add(np.char.add(p["prod_id"], ","), np.char.add(p["product"], ",")),
+                    p["price"],
+                )
+                f.write("\n".join(body.tolist()) + "\n")
+            out = subprocess.run(
+                [exe, f"{td}/orders.csv", f"{td}/customers.csv", f"{td}/products.csv"],
+                capture_output=True,
+                text=True,
+                timeout=min(120, max(10, _remaining() * 0.25)),
+            )
+        rate = float(out.stdout.split()[0])
+        sys.stderr.write(f"bench: go-class C++ proxy {rate:,.0f} rows/s (n={cap})\n")
+        return rate
+    except Exception as e:  # noqa: BLE001 — informational tier only
+        sys.stderr.write(f"bench: go-class proxy unavailable ({e})\n")
+        return None
+
+
+def _run_cpu_child() -> "dict | None":
+    """Run this benchmark hermetically on CPU in a subprocess and return
+    its record — the FLOOR that makes the record safe before any
+    accelerator attempt (VERDICT r3 next #1)."""
+    import json as _json
+    import subprocess
+
+    budget = max(60, min(_remaining() - 200, 300))
+    env = dict(os.environ)
+    env["CSVPLUS_BENCH_HERMETIC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CSVPLUS_BENCH_BUDGET"] = repr(budget)
+    env["CSVPLUS_BENCH_DEADLINE_TS"] = repr(time.time() + budget)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.stderr.write(f"bench: CPU floor child starting (budget {budget:.0f}s)\n")
+    try:
+        child = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True,
+            text=True,
+            timeout=budget + 30,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("bench: CPU floor child timed out\n")
+        return None
+    for line in (child.stderr or "").splitlines():
+        sys.stderr.write(f"bench[cpu-floor] {line}\n")
+    for line in reversed((child.stdout or "").splitlines()):
+        try:
+            rec = _json.loads(line)
+            if isinstance(rec, dict) and rec.get("metric") == _METRIC:
+                return rec
+        except ValueError:
+            continue
+    return None
+
+
+def _orchestrate() -> None:
+    """Record-CPU-first, then re-probe the accelerator until ~150s of
+    budget remain; if the tunnel ever answers, re-exec into the
+    accelerator run with the floor carried along.  Every probe's stderr
+    is logged so a dead tunnel is diagnosable from the bench tail."""
+    import json as _json
+
+    if _remaining() < 240:
+        # too little budget for child + probing overhead: run hermetic
+        # CPU directly (the old short-budget behavior)
+        _fallback_to_cpu("budget too small for accelerator orchestration")
+    floor = _run_cpu_child()
+    if floor is not None:
+        _recorder.register(floor)
+        sys.stderr.write(
+            f"bench: CPU floor recorded ({floor.get('value', 0):,.0f} rows/s);"
+            " probing accelerator\n"
+        )
+    last_err = "no probe attempted"
+    attempt = 0
+    while _remaining() > 150:
+        attempt += 1
+        timeout = min(
+            int(os.environ.get("CSVPLUS_BENCH_PROBE_TIMEOUT", 45)),
+            _remaining() - 120,
+        )
+        ok, err = _probe_backend(timeout)
+        if ok:
+            sys.stderr.write(f"bench: accelerator probe {attempt} OK; re-exec onto it\n")
+            env = dict(os.environ)
+            env["CSVPLUS_BENCH_PROBED"] = "1"
+            if floor is not None:
+                env["CSVPLUS_BENCH_FLOOR"] = _json.dumps(floor)
+            os.execve(sys.executable, [sys.executable] + sys.argv, env)
+        last_err = err or "unknown probe failure"
+        sys.stderr.write(
+            f"bench: probe {attempt} failed ({last_err.splitlines()[-1][:160] if last_err.strip() else 'no stderr'});"
+            f" remaining={_remaining():.0f}s\n"
+        )
+        if _remaining() > 180:
+            time.sleep(int(os.environ.get("CSVPLUS_BENCH_PROBE_BACKOFF", 20)))
+        else:
+            break
+    record = floor or {
+        "metric": _METRIC,
+        "value": 0.0,
+        "unit": "rows/s",
+        "vs_baseline": 0.0,
+        "backend": "none",
+    }
+    record["probe_error"] = last_err[-300:]
+    record["note"] = "accelerator unreachable for the whole budget; CPU floor record"
+    _recorder.register(record)
+    _recorder.print_once()
+    os._exit(0)
+
+
 def main() -> None:
     _start_watchdog()
+    hermetic = os.environ.get("CSVPLUS_BENCH_HERMETIC") == "1"
+    probed = os.environ.get("CSVPLUS_BENCH_PROBED") == "1"
+    if not hermetic and not probed:
+        _orchestrate()  # never returns
+    if probed:
+        floor_json = os.environ.get("CSVPLUS_BENCH_FLOOR")
+        if floor_json:
+            try:
+                import json as _json
+
+                floor = _json.loads(floor_json)
+                _recorder.register(floor)  # safe record if nothing else lands
+                _recorder.register_floor(floor)  # a slower chip cannot beat it
+            except ValueError:
+                pass
     _guard_backend()
     import jax
 
@@ -369,20 +572,39 @@ def main() -> None:
             "value": round(host_rps, 1),
             "unit": "rows/s",
             "vs_baseline": 1.0,
+            "baseline_kind": "python_host_executor",
             "backend": "host-executor",
             "note": "floor record: host baseline only (device not yet measured)",
         }
     )
+    # the Go-class C++ proxy bound (reused from the CPU floor when this
+    # is the accelerator re-exec — chip time is not spent re-measuring a
+    # CPU-only number)
+    floor_env = os.environ.get("CSVPLUS_BENCH_FLOOR", "")
+    go_rps = None
+    if "go_class_proxy_rows_per_sec" in floor_env:
+        try:
+            import json as _json
+
+            go_rps = _json.loads(floor_env).get("go_class_proxy_rows_per_sec")
+        except ValueError:
+            pass
+    if go_rps is None:
+        go_rps = _go_class_proxy(data)
     dev_rps, coarse_wall = _bench_device(data, max(2, reps // 2))
     record = {
         "metric": _METRIC,
         "value": round(dev_rps, 1),
         "unit": "rows/s",
         "vs_baseline": round(dev_rps / host_rps, 2),
+        "baseline_kind": "python_host_executor",
         "backend": backend,
         "n_orders": coarse_n,
         "link_rtt_ms": round(rtt, 1),
     }
+    if go_rps:
+        record["go_class_proxy_rows_per_sec"] = round(go_rps, 1)
+        record["vs_go_class_proxy"] = round(dev_rps / go_rps, 2)
     _recorder.register(record)
     sys.stderr.write(
         f"bench: coarse tier n={coarse_n} -> {dev_rps:,.0f} rows/s"
@@ -403,6 +625,8 @@ def main() -> None:
             vs_baseline=round(dev_rps_full / host_rps, 2),
             n_orders=n_orders,
         )
+        if go_rps:
+            record["vs_go_class_proxy"] = round(dev_rps_full / go_rps, 2)
         _recorder.register(record)
         sys.stderr.write(
             f"bench: full tier n={n_orders} -> {dev_rps_full:,.0f} rows/s"
@@ -582,6 +806,13 @@ def _secondary_metrics(n_orders: int) -> None:
                         )
                     )
                 )
+            # warm the dispatch path on a 2K-row slice so the tier times
+            # ingest itself, not the process's first jax trace/compile
+            wpath = f"{td}/warm.csv"
+            with open(wpath, "w") as f:
+                f.write("order_id,cust_id,qty\n")
+                f.write("".join(f"{i},c{i % 97},{i % 9}\n" for i in range(2000)))
+            from_file(wpath).on_device().plan.table.sync()
             t0 = time.perf_counter()
             src = from_file(path).on_device()
             # sync the ingested code arrays (async dispatch would stop the
@@ -591,18 +822,23 @@ def _secondary_metrics(n_orders: int) -> None:
             t_ingest = time.perf_counter() - t0
             t0 = time.perf_counter()
             idx = src.index_on("cust_id")
-            _ = len(idx)
+            idx.sync()  # the async device build must land in THIS timer
             t_index = time.perf_counter() - t0
             # BASELINE config 2's lookup half: point Find()s against the
             # device index (host-mirrored key search + range decode);
             # probe keys sampled from the generated ids so every lookup
-            # is a guaranteed hit at any row count
+            # is a guaranteed hit at any row count.  A short warmup pays
+            # the one-time host mirror transfer outside the steady-state
+            # rate (it is reported separately).
             lookups = 1000
             probes = [f"c{int(v)}" for v in ids[:lookups]]
             t0 = time.perf_counter()
+            warm_hits = sum(len(idx.find(p).to_rows()) > 0 for p in probes[:10])
+            t_mirror = time.perf_counter() - t0
+            t0 = time.perf_counter()
             hits = sum(len(idx.find(p).to_rows()) > 0 for p in probes)
             t_find = time.perf_counter() - t0
-            assert hits == len(probes)
+            assert hits == len(probes) and warm_hits == 10
             t0 = time.perf_counter()
             idx.resolve_duplicates("first")
             _ = len(idx)
@@ -610,7 +846,8 @@ def _secondary_metrics(n_orders: int) -> None:
         sys.stderr.write(
             f"bench[secondary]: ingest {n / t_ingest:,.0f} rows/s | "
             f"index build {n / t_index:,.0f} rows/s | "
-            f"device find {lookups / t_find:,.0f} lookups/s | "
+            f"device find {lookups / t_find:,.0f} lookups/s "
+            f"(one-time mirror {t_mirror * 1000:,.0f}ms) | "
             f"policy dedup {n / t_dedup:,.0f} rows/s (n={n})\n"
         )
     except Exception as e:  # secondary metrics must never break the line

@@ -1,0 +1,121 @@
+// Go-class CPU proxy for the reference's 3-way lookup join hot loop.
+//
+// Mirrors csvplus.go:552-583 per stream row: two binary searches over
+// sorted key arrays (sort.Search with per-key string compares,
+// csvplus.go:869-920) and two map merges into a freshly allocated row
+// map (mergeRows, csvplus.go:571-583), rows as string->string hash maps
+// (Go's map[string]string).  Compiled C++ is the same performance class
+// as compiled Go on this shape — hash-map churn and string compares
+// dominate — so its rows/s bounds the "vs Go" multiple honestly where
+// no Go toolchain exists (BASELINE.md metric definition).
+//
+// Usage: bench_oracle orders.csv customers.csv products.csv
+// Output: one line "<rows_per_sec>" (join loop only; IO/build excluded).
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+using Row = std::unordered_map<std::string, std::string>;
+
+static std::vector<std::string> split(const std::string& line) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  for (;;) {
+    size_t pos = line.find(',', start);
+    if (pos == std::string::npos) {
+      out.push_back(line.substr(start));
+      return out;
+    }
+    out.push_back(line.substr(start, pos - start));
+    start = pos + 1;
+  }
+}
+
+static bool read_csv(const char* path, std::vector<std::string>& header,
+                     std::vector<std::vector<std::string>>& rows) {
+  std::ifstream f(path);
+  if (!f) return false;
+  std::string line;
+  if (!std::getline(f, line)) return false;
+  header = split(line);
+  while (std::getline(f, line)) {
+    if (!line.empty()) rows.push_back(split(line));
+  }
+  return true;
+}
+
+// build side: rows sorted by one key column, searched like
+// indexImpl.find (two sort.Search calls -> lower bound on unique keys)
+struct Index {
+  std::vector<std::pair<std::string, Row>> rows;  // sorted by key
+  void build(const std::vector<std::string>& header,
+             std::vector<std::vector<std::string>>& data, const std::string& key) {
+    size_t ki = 0;
+    for (size_t i = 0; i < header.size(); ++i)
+      if (header[i] == key) ki = i;
+    rows.reserve(data.size());
+    for (auto& rec : data) {
+      Row r;
+      for (size_t i = 0; i < header.size() && i < rec.size(); ++i)
+        r.emplace(header[i], std::move(rec[i]));
+      rows.emplace_back(r.at(key), std::move(r));
+    }
+    std::sort(rows.begin(), rows.end(),
+              [](const auto& a, const auto& b) { return a.first < b.first; });
+  }
+  const Row* find(const std::string& v) const {
+    auto it = std::lower_bound(
+        rows.begin(), rows.end(), v,
+        [](const auto& a, const std::string& key) { return a.first < key; });
+    if (it == rows.end() || it->first != v) return nullptr;
+    return &it->second;
+  }
+};
+
+int main(int argc, char** argv) {
+  if (argc != 4) return 2;
+  std::vector<std::string> oh, ch, ph;
+  std::vector<std::vector<std::string>> orows, crows, prows;
+  if (!read_csv(argv[1], oh, orows) || !read_csv(argv[2], ch, crows) ||
+      !read_csv(argv[3], ph, prows))
+    return 3;
+  Index cust, prod;
+  cust.build(ch, crows, "id");
+  prod.build(ph, prows, "prod_id");
+
+  size_t cust_i = 0, prod_i = 0;
+  for (size_t i = 0; i < oh.size(); ++i) {
+    if (oh[i] == "cust_id") cust_i = i;
+    if (oh[i] == "prod_id") prod_i = i;
+  }
+
+  const auto t0 = std::chrono::steady_clock::now();
+  uint64_t matched = 0;
+  for (const auto& rec : orows) {
+    // stream row materializes as a map per record, like the reference's
+    // Reader.Iterate (csvplus.go:1118-1131)
+    Row stream;
+    for (size_t i = 0; i < oh.size() && i < rec.size(); ++i)
+      stream.emplace(oh[i], rec[i]);
+    const Row* c = cust.find(rec[cust_i]);
+    if (!c) continue;
+    Row merged = *c;  // mergeRows: index row copies first...
+    for (const auto& kv : stream) merged[kv.first] = kv.second;  // stream wins
+    const Row* p = prod.find(rec[prod_i]);
+    if (!p) continue;
+    Row merged2 = *p;
+    for (const auto& kv : merged) merged2[kv.first] = kv.second;
+    matched += merged2.size() >= stream.size();
+  }
+  const double dt =
+      std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+          .count();
+  std::printf("%.1f %llu\n", orows.size() / dt, (unsigned long long)matched);
+  return 0;
+}

@@ -144,8 +144,10 @@ def _stream_to_table(reader, path: str, device) -> DeviceTable:
     DEVICE via a gathered translation table; code order remains string
     order (table.py encoding invariant).
 
-    Memory contract: host RSS is bounded by ONE chunk of raw
-    bytes/offsets plus per-column dictionary state.  LOW-cardinality
+    Memory contract: host RSS is bounded by a CONSTANT number of chunks
+    of raw bytes/offsets — (CSVPLUS_STREAM_PREFETCH + 2) with the
+    default overlap pipeline, one with CSVPLUS_STREAM_PREFETCH=0 — plus
+    per-column dictionary state.  LOW-cardinality
     columns keep host dictionaries (total distinct values, flat at any
     file size).  A column whose running distinct count crosses
     ``CSVPLUS_DICT_DEVICE_MIN_DISTINCT`` (default 4M; values <= 32
@@ -168,6 +170,7 @@ def _stream_to_table(reader, path: str, device) -> DeviceTable:
 
     dev = default_device(device)
     encoder = _device_chunk_encoder(dev) if _device_parse_enabled() else None
+    prefetch_depth = int(os.environ.get("CSVPLUS_STREAM_PREFETCH", "1"))
     lane_thresh = int(
         os.environ.get("CSVPLUS_DICT_DEVICE_MIN_DISTINCT", 4_000_000)
     )
@@ -187,7 +190,13 @@ def _stream_to_table(reader, path: str, device) -> DeviceTable:
         lanes = lanes_for_width(max_width[c])
         return tuple(jax.device_put(l, dev) for l in pack_host(d, lanes))
 
-    for cnames, encoded, n in stream_encoded_chunks(reader, path, encoder=encoder):
+    chunks = stream_encoded_chunks(reader, path, encoder=encoder)
+    if prefetch_depth > 0:
+        # overlap chunk N+1's read+scan+encode (producer thread) with
+        # chunk N's upload + dictionary-union bookkeeping (this thread);
+        # host RSS bound becomes (depth + 2) chunks instead of 1
+        chunks = _prefetch_iter(chunks, prefetch_depth)
+    for cnames, encoded, n in chunks:
         if names is None:
             names = cnames
             chunk_dicts = {c: [] for c in names}
@@ -283,6 +292,22 @@ def _stream_to_table(reader, path: str, device) -> DeviceTable:
         # shape, which dominated the wall time at north-star scale
         out[c] = (union, _remap_concat(mappings, codes))
     return DeviceTable.from_encoded(out, nrows, device=dev)
+
+
+def _prefetch_iter(gen, depth: int):
+    """Run *gen* on a background thread, buffering up to *depth* items —
+    the streamed tier's read+scan+encode then overlaps the consumer's
+    device uploads (VERDICT r3 #3).  Exceptions (StreamFallback,
+    DataSourceError, ...) re-raise in the consumer at the position they
+    occurred; abandoning the iterator stops the producer promptly so a
+    fallback path cannot leak a thread pinning chunk memory."""
+    from ..utils.relay import relay_iter
+
+    def run(emit) -> None:
+        for item in gen:
+            emit(item)
+
+    return relay_iter(run, maxsize=depth)
 
 
 def _device_chunk_encoder(device):

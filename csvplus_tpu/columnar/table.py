@@ -245,9 +245,24 @@ class StringColumn:
         """Decode a host code slice against this column's dictionary;
         absent cells (negative codes, incl. the -2 sharding pad) become
         None.  The single definition of host-side code decoding, shared
-        by :meth:`decode` and :meth:`DeviceTable.rows_from_mirror`."""
+        by :meth:`decode` and :meth:`DeviceTable.rows_from_mirror`.
+
+        Small slices (point lookups) decode only the matched dictionary
+        entries: decoding a 1M-entry dictionary to serve a 10-row
+        ``Index.find`` cost ~1.3s of one-time work and was the round-3
+        "device find 665 lookups/s" bottleneck."""
         if self.dict_size == 0:
             return [None] * codes.shape[0]
+        if self._str_dict is None and codes.shape[0] * 16 < self.dict_size:
+            d = self.dictionary
+            sel = d[np.clip(codes, 0, d.size - 1)]
+            if d.dtype.kind == "S":
+                out = [v.decode("utf-8") for v in sel.tolist()]
+            else:
+                out = sel.tolist()
+            if (codes < 0).any():
+                out = [None if c < 0 else v for c, v in zip(codes.tolist(), out)]
+            return out
         d = self.dictionary_str()
         vals = d[np.clip(codes, 0, d.size - 1)]
         out = vals.tolist()

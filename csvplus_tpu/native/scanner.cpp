@@ -248,4 +248,164 @@ void csv_pack_fields_u64(const char* buf, const int64_t* starts,
   }
 }
 
+// Unpack k big-endian-packed u64 dictionary values into NUL-padded
+// fixed-width byte rows (the 'S{width}' dictionary array) — replaces a
+// numpy (k, width) shift-and-mask broadcast that dominated the encode
+// of high-cardinality columns.
+void csv_u64_to_bytes(const uint64_t* uniq, int64_t k, int32_t width,
+                      char* out) {
+  for (int64_t i = 0; i < k; ++i) {
+    const uint64_t be = __builtin_bswap64(uniq[i]);  // memory order = byte order
+    memcpy(out + i * (int64_t)width, &be, (size_t)width);
+  }
+}
+
+// Branchless-ish SWAR tokenizer for SIMPLE chunks: no quote bytes, no
+// CR, no comment lines (caller prechecks with memchr).  Only field
+// boundaries exist, so each record is delimiter-split text ending at
+// '\n'; blank lines are skipped at record start like the full state
+// machine.  Emits the same (starts, lens, counts) layout as csv_scan
+// with nothing in scratch.  Returns total fields.
+int64_t csv_scan_simple(const char* buf, int64_t len, char delim,
+                        int64_t* field_starts, int32_t* field_lens,
+                        int32_t* rec_counts, int64_t* nrec_out) {
+  constexpr uint64_t kOnes = 0x0101010101010101ull;
+  constexpr uint64_t kHighs = 0x8080808080808080ull;
+  const uint64_t dmask = kOnes * (uint8_t)delim;
+  const uint64_t nmask = kOnes * (uint8_t)'\n';
+  int64_t nfields = 0;
+  int64_t nrec = 0;
+  int64_t pos = 0;
+  while (pos < len) {
+    if (buf[pos] == '\n') {  // blank line at record start: skip
+      pos++;
+      continue;
+    }
+    int32_t fields_in_rec = 0;
+    int64_t field_start = pos;
+    for (;;) {
+      // scan 8 bytes at a time for delim or newline
+      uint64_t hit = 0;
+      while (pos + 8 <= len) {
+        uint64_t w;
+        memcpy(&w, buf + pos, 8);
+        const uint64_t dx = w ^ dmask;
+        const uint64_t nx = w ^ nmask;
+        hit = ((dx - kOnes) & ~dx & kHighs) | ((nx - kOnes) & ~nx & kHighs);
+        if (hit) break;
+        pos += 8;
+      }
+      if (hit) {
+        pos += __builtin_ctzll(hit) >> 3;
+      } else {
+        while (pos < len && buf[pos] != delim && buf[pos] != '\n') pos++;
+      }
+      field_starts[nfields] = field_start;
+      field_lens[nfields] = (int32_t)(pos - field_start);
+      nfields++;
+      fields_in_rec++;
+      if (pos >= len) break;            // EOF ends the record
+      const char c = buf[pos++];
+      if (c == '\n') break;             // record done
+      field_start = pos;                // c == delim: next field
+      if (pos >= len) {                 // trailing delimiter at EOF:
+        field_starts[nfields] = pos;    // empty last field
+        field_lens[nfields] = 0;
+        nfields++;
+        fields_in_rec++;
+        break;
+      }
+    }
+    rec_counts[nrec++] = fields_in_rec;
+  }
+  *nrec_out = nrec;
+  return nfields;
+}
+
+// Hash-based dictionary encode for u64-packed fields: one linear-probe
+// pass assigns provisional codes in first-seen order (uniq_out gets the
+// distinct values unsorted; the caller sorts the small distinct set and
+// rank-remaps the codes).  Returns the distinct count, or -1 when it
+// exceeds max_k — high-cardinality columns bail to the sort path, so
+// the probe table stays small and cache-resident for the low-
+// cardinality columns this exists for.
+// splitmix64-style finalizer: every input bit affects every output bit.
+// Packed fields carry their bytes big-endian (short values vary ONLY in
+// the high bits), so a plain multiply-shift hash would drop exactly the
+// bits that differ and collapse whole columns into one probe chain.
+static inline uint64_t mix64(uint64_t h) {
+  h ^= h >> 33;
+  h *= 0xFF51AFD7ED558CCDull;
+  h ^= h >> 33;
+  h *= 0xC4CEB9FE1A85EC53ull;
+  h ^= h >> 33;
+  return h;
+}
+
+int64_t csv_encode_hash_u64(const uint64_t* packed, int64_t n,
+                            uint64_t* uniq_out, int32_t* prov_codes,
+                            int64_t max_k) {
+  // Start small and double (load kept <= 1/2): a 5-distinct column on a
+  // 100M-row file probes a cache-resident 64K-slot table, never a
+  // max_k-sized one.  `limit` bounds growth; hitting max_k inserts bails.
+  int64_t limit = 1 << 16;
+  while (limit < 2 * max_k) limit <<= 1;
+  int64_t cap = limit < (1 << 16) ? limit : (1 << 16);
+  uint64_t* keys = new uint64_t[cap];
+  int32_t* slots = new int32_t[cap];
+  memset(slots, 0xFF, (size_t)cap * sizeof(int32_t));  // -1 = empty
+  uint64_t mask = (uint64_t)cap - 1;
+  int64_t grow_at = cap >> 1;
+  int64_t k = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t v = packed[i];
+    uint64_t j = mix64(v) & mask;
+    for (;;) {
+      const int32_t s = slots[j];
+      if (s < 0) {
+        if (k >= max_k) {
+          delete[] keys;
+          delete[] slots;
+          return -1;
+        }
+        slots[j] = (int32_t)k;
+        keys[j] = v;
+        uniq_out[k] = v;
+        prov_codes[i] = (int32_t)k;
+        k++;
+        break;
+      }
+      if (keys[j] == v) {
+        prov_codes[i] = s;
+        break;
+      }
+      j = (j + 1) & mask;
+    }
+    if (k >= grow_at && cap < limit) {  // rehash-double
+      const int64_t ncap = cap << 1;
+      uint64_t* nkeys = new uint64_t[ncap];
+      int32_t* nslots = new int32_t[ncap];
+      memset(nslots, 0xFF, (size_t)ncap * sizeof(int32_t));
+      const uint64_t nmask = (uint64_t)ncap - 1;
+      for (int64_t o = 0; o < cap; ++o) {
+        if (slots[o] < 0) continue;
+        uint64_t j2 = mix64(keys[o]) & nmask;
+        while (nslots[j2] >= 0) j2 = (j2 + 1) & nmask;
+        nslots[j2] = slots[o];
+        nkeys[j2] = keys[o];
+      }
+      delete[] keys;
+      delete[] slots;
+      keys = nkeys;
+      slots = nslots;
+      cap = ncap;
+      mask = nmask;
+      grow_at = cap >> 1;
+    }
+  }
+  delete[] keys;
+  delete[] slots;
+  return k;
+}
+
 }  // extern "C"

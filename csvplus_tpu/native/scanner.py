@@ -95,6 +95,48 @@ def _load():
             ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.csv_pack_fields.restype = None
+        lib.csv_pack_fields.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+        ]
+        lib.csv_pack_fields_u64.restype = None
+        lib.csv_pack_fields_u64.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.c_void_p,
+        ]
+        lib.csv_encode_hash_u64.restype = ctypes.c_int64
+        lib.csv_encode_hash_u64.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+        ]
+        lib.csv_u64_to_bytes.restype = None
+        lib.csv_u64_to_bytes.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+        ]
+        lib.csv_scan_simple.restype = ctypes.c_int64
+        lib.csv_scan_simple.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_char,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
         _lib = lib
         return lib
 
@@ -130,6 +172,33 @@ def scan_bytes(
     starts = np.empty(mf, dtype=np.int64)
     lens = np.empty(mf, dtype=np.int32)
     counts = np.empty(mr, dtype=np.int32)
+
+    # SIMPLE fast path: no quotes, no CR, no comment bytes in range —
+    # the SWAR tokenizer applies (~4x the state machine's throughput),
+    # no scratch buffer exists, and no parse error is possible
+    if (
+        data.find(b'"', offset, offset + n) < 0
+        and data.find(b"\r", offset, offset + n) < 0
+        and (
+            comment is None
+            or len(comment.encode("utf-8")) != 1
+            or data.find(comment.encode("utf-8"), offset, offset + n) < 0
+        )
+    ):
+        nrec = ctypes.c_int64(0)
+        total = int(
+            lib.csv_scan_simple(
+                base,
+                n,
+                delimiter.encode("utf-8"),
+                starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                ctypes.byref(nrec),
+            )
+        )
+        return starts[:total], lens[:total], counts[: nrec.value], b""
+
     # NB: the `data` local keeps the bytes object alive (and its base
     # address valid) for the duration of both native calls below
     scratch = ctypes.create_string_buffer(max(n, 1))
@@ -226,6 +295,75 @@ def _field_str(data: bytes, scratch: bytes, start: int, length: int) -> str:
 
 
 _VEC_MAX_FIELD_LEN = 256  # longer fields fall back to per-field strings
+_PACK_THREADS_MIN_N = 200_000  # below this a single pack call is faster
+_pack_pool = None
+_pack_pool_lock = threading.Lock()
+
+
+def _pack_pool_get():
+    """Shared worker pool for the native field pack (row-range slices).
+    Distinct from any column-level pool a caller may run, so nested use
+    cannot deadlock (pack tasks never submit further pack tasks)."""
+    global _pack_pool
+    if _pack_pool is None:
+        with _pack_pool_lock:
+            if _pack_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                _pack_pool = ThreadPoolExecutor(
+                    max_workers=min(os.cpu_count() or 1, 8),
+                    thread_name_prefix="csvplus-pack",
+                )
+    return _pack_pool
+
+
+def _pack_fields_native(
+    combined: np.ndarray, starts: np.ndarray, lens: np.ndarray, width: int,
+    u64: bool = False,
+):
+    """Gather (start, len) fields into NUL-padded fixed-width rows via the
+    C++ pack (one memcpy per field, GIL released, threaded over row
+    ranges) — or None when the native library is unavailable.
+
+    ``u64=True`` packs <=8-byte fields big-endian straight into native
+    uint64 values (integer order == padded byte order)."""
+    try:
+        lib = _load()
+    except ImportError:
+        return None
+    n = int(starts.shape[0])
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    out = (
+        np.empty(n, dtype=np.uint64) if u64 else np.empty((n, width), np.uint8)
+    )
+    if n == 0:
+        return out
+    base = combined.ctypes.data
+
+    def run(lo: int, hi: int) -> None:
+        sp = starts[lo:hi].ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        lp = lens[lo:hi].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        if u64:
+            lib.csv_pack_fields_u64(base, sp, lp, hi - lo, out[lo:hi].ctypes.data)
+        else:
+            lib.csv_pack_fields(
+                base, sp, lp, hi - lo, width, out[lo:hi].ctypes.data
+            )
+
+    k = min(os.cpu_count() or 1, 8)
+    if n >= _PACK_THREADS_MIN_N and k >= 2:
+        # single-core boxes skip straight to one call: pool hops only
+        # add GIL churn there
+        bounds = [n * i // k for i in range(k + 1)]
+        list(
+            _pack_pool_get().map(
+                lambda b: run(*b), zip(bounds[:-1], bounds[1:])
+            )
+        )
+    else:
+        run(0, n)
+    return out
 
 
 def encode_fields_vectorized(
@@ -234,14 +372,16 @@ def encode_fields_vectorized(
     """Dictionary-encode a column directly from (start, len) offsets with
     zero per-field Python objects.
 
-    Gathers every field into a NUL-padded (n, L) byte matrix, views rows
-    as fixed-width scalars and runs ``np.unique`` — byte order on padded
-    UTF-8 equals code-point order (no field contains NUL; caller checks),
-    so the resulting codes are order-preserving exactly like
+    Gathers every field into a NUL-padded (n, L) byte matrix — via the
+    native C++ pack when available (one memcpy per field, threaded),
+    else a numpy index-matrix gather — views rows as fixed-width scalars
+    and runs ``np.unique``.  Byte order on padded UTF-8 equals
+    code-point order (no field contains NUL; caller checks), so the
+    resulting codes are order-preserving exactly like
     :func:`csvplus_tpu.columnar.table.encode_strings`.
 
-    Returns (dictionary of np.str_, int32 codes) or None when a field is
-    too long for the padded-matrix approach.
+    Returns (dictionary of 'S' bytes, int32 codes) or None when a field
+    is too long for the padded-matrix approach.
     """
     n = starts.shape[0]
     if n == 0:
@@ -250,28 +390,85 @@ def encode_fields_vectorized(
     if L > _VEC_MAX_FIELD_LEN:
         return None
     L = max(L, 1)
-    idx = starts[:, None] + np.arange(L, dtype=np.int64)[None, :]
-    mask = np.arange(L, dtype=np.int32)[None, :] < lens[:, None]
-    mat = np.where(mask, combined[np.minimum(idx, combined.shape[0] - 1)], 0).astype(
-        np.uint8
-    )
     if L <= 8:
-        # pack padded bytes big-endian into uint64: integer order equals
-        # byte order, and np.unique on a native scalar dtype is fast
-        shifts = (1 << (8 * np.arange(7, 7 - L, -1, dtype=np.uint64))).astype(
-            np.uint64
-        )
-        packed = mat.astype(np.uint64) @ shifts
-        uniq64, codes = np.unique(packed, return_inverse=True)
-        back = (8 * np.arange(7, 7 - L, -1, dtype=np.int64)).astype(np.uint64)
-        ub = ((uniq64[:, None] >> back[None, :]) & np.uint64(0xFF)).astype(np.uint8)
-        dictionary = np.ascontiguousarray(ub).view(f"S{L}").ravel()
+        packed = _pack_fields_native(combined, starts, lens, 8, u64=True)
+        if packed is None:
+            mat = _gather_numpy(combined, starts, lens, L)
+            shifts = (1 << (8 * np.arange(7, 7 - L, -1, dtype=np.uint64))).astype(
+                np.uint64
+            )
+            packed = mat.astype(np.uint64) @ shifts
+            uniq64, codes = np.unique(packed, return_inverse=True)
+        else:
+            uniq64, codes = _encode_u64(packed)
+        dictionary = _u64_dictionary_bytes(uniq64, L)
         return dictionary, codes.ravel().astype(np.int32)
+    mat = _pack_fields_native(combined, starts, lens, L)
+    if mat is None:
+        mat = _gather_numpy(combined, starts, lens, L)
     as_void = np.ascontiguousarray(mat).view([("v", f"V{L}")])["v"].ravel()
     uniq, codes = np.unique(as_void, return_inverse=True)
     # keep the dictionary as UTF-8 bytes; sinks decode lazily
     dictionary = uniq.view(f"S{L}").ravel()
     return dictionary, codes.ravel().astype(np.int32)
+
+
+def _encode_u64(packed: np.ndarray):
+    """Dictionary-encode packed u64 fields: np.unique output contract.
+
+    Tier order: C++ hash encode (one linear-probe pass; wins whenever
+    the distinct count is < n/4 — the common join-key/category shape),
+    else np.unique's argsort.  A C++ LSD radix sort was tried for the
+    high-cardinality tier and measured SLOWER than np.unique on real
+    string-packed keys (their spread bytes defeat the radix digit-skip),
+    so the bail path stays numpy."""
+    n = packed.shape[0]
+    try:
+        lib = _load()
+    except ImportError:
+        return np.unique(packed, return_inverse=True)
+    max_k = max(1024, n // 4)
+    uniq = np.empty(max_k, dtype=np.uint64)
+    prov = np.empty(n, dtype=np.int32)
+    k = lib.csv_encode_hash_u64(
+        packed.ctypes.data, n, uniq.ctypes.data, prov.ctypes.data, max_k
+    )
+    if k >= 0:
+        d = uniq[:k]
+        order = np.argsort(d)
+        rank = np.empty(k, dtype=np.int32)
+        rank[order] = np.arange(k, dtype=np.int32)
+        return d[order], rank[prov]
+    return np.unique(packed, return_inverse=True)  # high cardinality
+
+
+def _u64_dictionary_bytes(uniq64: np.ndarray, L: int) -> np.ndarray:
+    """Big-endian-packed u64 dictionary values -> 'S{L}' bytes array
+    (native store loop when available; numpy shift-mask otherwise)."""
+    k = uniq64.shape[0]
+    uniq64 = np.ascontiguousarray(uniq64, dtype=np.uint64)
+    try:
+        lib = _load()
+    except ImportError:
+        back = (8 * np.arange(7, 7 - L, -1, dtype=np.int64)).astype(np.uint64)
+        ub = ((uniq64[:, None] >> back[None, :]) & np.uint64(0xFF)).astype(np.uint8)
+        return np.ascontiguousarray(ub).view(f"S{L}").ravel()
+    out = np.empty((k, L), dtype=np.uint8)
+    if k:
+        lib.csv_u64_to_bytes(uniq64.ctypes.data, k, L, out.ctypes.data)
+    return out.view(f"S{L}").ravel()
+
+
+def _gather_numpy(
+    combined: np.ndarray, starts: np.ndarray, lens: np.ndarray, L: int
+) -> np.ndarray:
+    """The pure-numpy padded gather (fallback when the toolchain is
+    absent): index matrix + mask, identical output to the C++ pack."""
+    idx = starts[:, None] + np.arange(L, dtype=np.int64)[None, :]
+    mask = np.arange(L, dtype=np.int32)[None, :] < lens[:, None]
+    return np.where(
+        mask, combined[np.minimum(idx, combined.shape[0] - 1)], 0
+    ).astype(np.uint8)
 
 
 def _column_positions(data_counts, field_offset, header, rec_base, pad_allowed):
@@ -403,18 +600,76 @@ def read_encoded_columns_native(reader, path: str):
     base = len(data)
     abs_starts = np.where(starts >= 0, starts, base + (-starts - 1))
 
-    out = {}
     pad_allowed = reader._num_fields < 0
-    for name, pos, ok in _column_positions(
-        data_counts, field_offset, header, rec_base, pad_allowed
-    ):
-        col_starts = np.where(ok, abs_starts[np.where(ok, pos, 0)], 0)
-        col_lens = np.where(ok, lens[np.where(ok, pos, 0)], 0)
-        enc = encode_fields_vectorized(combined, col_starts, col_lens.astype(np.int32))
+    cols = list(
+        _column_positions(data_counts, field_offset, header, rec_base, pad_allowed)
+    )
+
+    def enc_one(args):
+        name, pos, ok = args
+        if ok.all():
+            col_starts, col_lens = abs_starts[pos], lens[pos]
+        else:
+            col_starts = np.where(ok, abs_starts[np.where(ok, pos, 0)], 0)
+            col_lens = np.where(ok, lens[np.where(ok, pos, 0)], 0)
+        enc = encode_fields_vectorized(
+            combined, col_starts, col_lens.astype(np.int32)
+        )
         if enc is None:
-            return None  # long fields: let the string path handle it
-        out[name] = enc
+            raise _EncodeFallback(name)
+        return name, enc
+
+    try:
+        out = dict(_map_columns(enc_one, cols))
+    except _EncodeFallback:
+        return None  # long fields: let the string path handle it
     return list(header), out
+
+
+class _EncodeFallback(Exception):
+    """A column declined the vectorized encode (over-long field); the
+    caller abandons the whole encode immediately instead of paying for
+    the remaining columns and then discarding everything."""
+
+
+_col_pool = None
+_col_pool_lock = threading.Lock()
+
+
+def _col_pool_get():
+    """Persistent column-encode pool (distinct from the pack pool —
+    column tasks submit pack tasks, so they must not share one pool)."""
+    global _col_pool
+    if _col_pool is None:
+        with _col_pool_lock:
+            if _col_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                _col_pool = ThreadPoolExecutor(
+                    max_workers=max(2, min((os.cpu_count() or 2) // 2, 8)),
+                    thread_name_prefix="csvplus-col",
+                )
+    return _col_pool
+
+
+def _map_columns(fn, cols):
+    """Run *fn* over the columns — concurrently when there are several,
+    the rows are many, and more than one core exists (np.unique and the
+    native pack both release the GIL).  An exception from any column
+    (e.g. :class:`_EncodeFallback`) cancels the not-yet-started rest."""
+    if (
+        len(cols) < 2
+        or (os.cpu_count() or 1) < 2
+        or (cols and cols[0][1].shape[0] < _PACK_THREADS_MIN_N)
+    ):
+        return [fn(c) for c in cols]
+    futs = [_col_pool_get().submit(fn, c) for c in cols]
+    try:
+        return [f.result() for f in futs]
+    except BaseException:
+        for f in futs:
+            f.cancel()
+        raise
 
 
 class StreamFallback(Exception):
@@ -571,15 +826,21 @@ def stream_encoded_chunks(
                 if scratch
                 else starts
             )
-            out = {}
-            for name, pos, ok in _column_positions(
-                data_counts, field_offset, header, first_data_record, pad_allowed
-            ):
-                col_starts = abs_starts[np.where(ok, pos, 0)]
-                col_starts = np.where(ok, col_starts, 0)
-                col_lens = np.where(ok, lens[np.where(ok, pos, 0)], 0).astype(
-                    np.int32
+            cols = list(
+                _column_positions(
+                    data_counts, field_offset, header, first_data_record, pad_allowed
                 )
+            )
+
+            def enc_one(args):
+                name, pos, ok = args
+                if ok.all():
+                    col_starts, col_lens = abs_starts[pos], lens[pos].astype(np.int32)
+                else:
+                    col_starts = np.where(ok, abs_starts[np.where(ok, pos, 0)], 0)
+                    col_lens = np.where(ok, lens[np.where(ok, pos, 0)], 0).astype(
+                        np.int32
+                    )
                 enc = (
                     encoder(combined, enc_data, col_starts, col_lens)
                     if encoder is not None
@@ -589,7 +850,15 @@ def stream_encoded_chunks(
                     enc = encode_fields_vectorized(combined, col_starts, col_lens)
                 if enc is None:
                     raise StreamFallback("field too long for vectorized encode")
-                out[name] = enc
+                return name, enc
+
+            # device-encode chunks stay serial (one upload stream); host
+            # encodes thread across columns
+            out = dict(
+                [enc_one(c) for c in cols]
+                if encoder is not None
+                else _map_columns(enc_one, cols)
+            )
             yield names, out, int(data_counts.shape[0])
 
 

@@ -29,8 +29,6 @@ parity by falling back to the host streaming path.
 
 from __future__ import annotations
 
-import queue as _queue
-import threading as _threading
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
 
 from .errors import CsvPlusError, DataSourceError, StopPipeline
@@ -105,43 +103,22 @@ class DataSource:
         """Pythonic pull iteration (streaming, bounded buffer).
 
         The push-based pipeline runs in a helper thread; rows cross through
-        a bounded queue, so memory use stays constant for long streams.
-        Abandoning the iterator stops the producer.
+        a bounded queue (:func:`csvplus_tpu.utils.relay.relay_iter`), so
+        memory use stays constant for long streams.  Abandoning the
+        iterator stops the producer.
         """
-        q: _queue.Queue = _queue.Queue(maxsize=1024)
-        _SENTINEL = object()
-        stop = _threading.Event()
+        from .utils.relay import RelayStopped, relay_iter
 
-        def producer() -> None:
-            try:
-                def fn(row: Row) -> None:
-                    if stop.is_set():
-                        raise StopPipeline
-                    q.put(row)
-
-                self(fn)
-                q.put(_SENTINEL)
-            except BaseException as e:  # propagate to consumer
-                q.put(e)
-
-        t = _threading.Thread(target=producer, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is _SENTINEL:
-                    return
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-        finally:
-            stop.set()
-            # drain so the producer is never blocked on put()
-            while t.is_alive():
+        def run(emit) -> None:
+            def fn(row: Row) -> None:
                 try:
-                    q.get_nowait()
-                except _queue.Empty:
-                    t.join(timeout=0.05)
+                    emit(row)
+                except RelayStopped:
+                    raise StopPipeline from None
+
+            self(fn)
+
+        return relay_iter(run, maxsize=1024)
 
     # -- per-row lazy combinators (csvplus.go:258-310) ---------------------
 

@@ -174,14 +174,86 @@ def test_policy_dedup_equivalent_to_callback(host_people):
 
 
 def test_callback_dedup_on_device_index(dev_people, host_people):
-    """Arbitrary callbacks force materialization but stay correct."""
+    """A member-choosing callback streams ONLY the duplicate groups'
+    rows to host and compacts columnar (VERDICT r3 #10): the index stays
+    device-lazy and the result matches the host path exactly."""
+    from csvplus_tpu import TakeRows
+    from csvplus_tpu.columnar.table import DeviceTable
+    from csvplus_tpu.columnar.ingest import source_from_table
+
+    # 1000 mostly-unique keys with 10 duplicate groups of 3 -> exactly
+    # 30 rows live in duplicate groups
+    rows = [Row({"k": f"k{i:04d}", "v": str(i)}) for i in range(970)]
+    for g in range(10):
+        for c in range(3):
+            rows.append(Row({"k": f"dup{g:02d}", "v": f"{g}-{c}"}))
+    dev_src = source_from_table(DeviceTable.from_rows(rows, device="cpu"))
+    di = dev_src.index_on("k")
+    hi = TakeRows(rows).index_on("k")
+    pick = lambda g: g[len(g) // 2]
+    decoded_counts = []
+    orig = DeviceTable.to_rows
+
+    def spy(self, sel=None):
+        decoded_counts.append(self.nrows if sel is None else len(sel))
+        return orig(self, sel)
+
+    DeviceTable.to_rows = spy
+    try:
+        di.resolve_duplicates(pick)
+    finally:
+        DeviceTable.to_rows = orig
+    hi.resolve_duplicates(pick)
+    assert di._impl.is_lazy  # stayed on device
+    # exactly the 30 duplicate-group rows were decoded, never the table
+    assert decoded_counts == [30]
+    assert Take(di).to_rows() == Take(hi).to_rows()
+    assert len(di) == 980
+
+
+def test_callback_dedup_device_drop_and_abort(dev_people, host_people):
+    """Drop-group (None / empty row) and abort (raise) semantics match
+    the host path on the streaming device dedup."""
     di = dev_people.index_on("name")
     hi = host_people.index_on("name")
-    pick = lambda g: g[len(g) // 2]
-    di.resolve_duplicates(pick)
-    hi.resolve_duplicates(pick)
+    drop_some = lambda g: None if g[0]["name"] < "F" else g[0]
+    di.resolve_duplicates(drop_some)
+    hi.resolve_duplicates(drop_some)
+    assert di._impl.is_lazy
     assert Take(di).to_rows() == Take(hi).to_rows()
-    assert di.device_table is None  # stale columnar copy dropped
+
+    di2 = dev_people.index_on("name")
+    before = Take(di2).to_rows()
+
+    def boom(g):
+        raise RuntimeError("abort dedup")
+
+    di3 = dev_people.index_on("name")
+    with pytest.raises(RuntimeError):
+        di3.resolve_duplicates(boom)
+    assert Take(di3).to_rows() == before  # unchanged on abort
+
+
+def test_callback_dedup_device_new_row(dev_people, host_people):
+    """A callback returning a BRAND-NEW row (not a group member) still
+    resolves correctly — one materialization, callback invoked exactly
+    once per group."""
+    calls_d, calls_h = [], []
+
+    def merge_d(g):
+        calls_d.append(len(g))
+        return Row({"id": g[0]["id"], "name": g[0]["name"] + "-merged"})
+
+    def merge_h(g):
+        calls_h.append(len(g))
+        return Row({"id": g[0]["id"], "name": g[0]["name"] + "-merged"})
+
+    di = dev_people.index_on("name")
+    hi = host_people.index_on("name")
+    di.resolve_duplicates(merge_d)
+    hi.resolve_duplicates(merge_h)
+    assert calls_d == calls_h  # same groups, one call each
+    assert Take(di).to_rows() == Take(hi).to_rows()
 
 
 def test_device_index_persistence_roundtrip(dev_people, tmp_path):
@@ -337,3 +409,22 @@ def test_point_bounds_host_mirror_parity(tmp_path):
     ks = sorted({f"{i % 7}" for i in range(40)})
     top = ks[-1]
     assert idx._impl.bounds((top,)) == host_idx._impl.bounds((top,))
+
+
+def test_callback_dedup_device_mutate_member(dev_people, host_people):
+    """A callback that MUTATES a group row in place and returns it must
+    keep the mutation (host-path semantics: the returned object is
+    appended) — the device path detects the mutation via pristine
+    clones and splices the mutated row."""
+
+    def mutate(g):
+        g[0]["name"] = g[0]["name"] + "-X"
+        return g[0]
+
+    di = dev_people.index_on("name")
+    hi = host_people.index_on("name")
+    di.resolve_duplicates(mutate)
+    hi.resolve_duplicates(mutate)
+    got = Take(di).to_rows()
+    assert got == Take(hi).to_rows()
+    assert any(r["name"].endswith("-X") for r in got)

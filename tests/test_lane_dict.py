@@ -198,3 +198,27 @@ def test_wide_probe_values_against_lane_index(highcard_csv, tmp_path):
     host_x = Take(from_file(str(p2))).except_(host_idx, "order_id").to_rows()
     dev_x = from_file(str(p2)).on_device().except_(idx, "order_id").to_rows()
     assert dev_x == host_x and len(dev_x) == 2
+
+
+def test_lane_index_persistence_roundtrip(highcard_csv, tmp_path):
+    """write_to/load_index on a lane-dictionary index persists the packed
+    lane arrays (no host dictionary materialization on either side —
+    VERDICT r3 #8) and round-trips queries exactly."""
+    from csvplus_tpu import load_index
+
+    idx = from_file(highcard_csv).on_device().unique_index_on("order_id")
+    impl = idx._impl
+    col = impl.dev.table.columns["order_id"]
+    assert col.dev_dictionary is not None and col._dictionary is None
+    path = str(tmp_path / "lane.idx")
+    idx.write_to(path)
+    assert col._dictionary is None  # the write did not download it
+
+    loaded = load_index(path)
+    lcol = loaded._impl.dev.table.columns["order_id"]
+    assert lcol.dev_dictionary is not None and lcol._dictionary is None
+    assert len(loaded) == len(idx) == 400
+    for probe in ("ord-000007", "ord-000399", "nope"):
+        assert loaded.find(probe).to_rows() == idx.find(probe).to_rows()
+    # full equality through a sink boundary
+    assert Take(loaded).to_rows() == Take(idx).to_rows()

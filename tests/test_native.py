@@ -270,3 +270,62 @@ def test_parallel_scan_quoted_falls_back(monkeypatch):
     s, l, c, scratch = sc.scan_bytes_parallel(data, n_threads=4)
     # fell back to single pass: quoted field parsed correctly
     assert c[0] == 2 and len(c) == 200
+
+
+def test_simple_scan_matches_state_machine():
+    """The SWAR simple-scan fast path (no quotes/CR/comments) produces
+    identical (starts, lens, counts) to the full state machine, which is
+    forced here by appending a quoted record to the same body."""
+    from csvplus_tpu.native import scanner as S
+
+    body = "a,b,c\n1,,3\n\n\nx,y z,w\ntrail,2,\nlast,9,8"
+    sS, lS, cS, scr = S.scan_bytes(body.encode())  # simple path (no quotes)
+    assert scr == b""
+    forced = body + '\n"q",1,2\n'
+    sF, lF, cF, _ = S.scan_bytes(forced.encode())  # full machine
+    # identical up to the appended record
+    assert (sS == sF[: sS.shape[0]]).all()
+    assert (lS == lF[: lS.shape[0]]).all()
+    assert (cS == cF[: cS.shape[0]]).all()
+
+
+def test_encode_u64_tiers_differential():
+    """The hash encode tier (and its bail-to-np.unique path) matches
+    np.unique exactly across cardinalities, including rehash growth and
+    big-endian string-packed values whose high-bit-only variation broke
+    the original multiply-shift hash."""
+    import numpy as np
+
+    from csvplus_tpu.native.scanner import _encode_u64
+
+    rng = np.random.default_rng(3)
+    for hi in (1, 5, 1000, 2**16, 2**32 + 7, 2**63):
+        arr = rng.integers(0, hi + 1, size=int(rng.integers(1, 60_000)), dtype=np.uint64)
+        want_u, want_c = np.unique(arr, return_inverse=True)
+        got_u, got_c = _encode_u64(arr)
+        assert (got_u == want_u).all() and (got_c == want_c).all(), hi
+    # high-bits-only variation (packed short strings): must not collapse
+    # into one probe chain nor miscode
+    short = (rng.integers(0x30, 0x3A, 50_000, dtype=np.uint64) << 56) | (
+        rng.integers(0x30, 0x3A, 50_000, dtype=np.uint64) << 48
+    )
+    want_u, want_c = np.unique(short, return_inverse=True)
+    got_u, got_c = _encode_u64(short)
+    assert (got_u == want_u).all() and (got_c == want_c).all()
+
+
+def test_u64_dictionary_bytes_matches_numpy():
+    import numpy as np
+
+    from csvplus_tpu.native.scanner import _u64_dictionary_bytes
+
+    rng = np.random.default_rng(5)
+    for L in (1, 3, 7, 8):
+        vals = rng.integers(0, 2**63, 50, dtype=np.uint64)
+        # mimic packed values: only top L bytes nonzero
+        vals = (vals >> (8 * (8 - L))) << (8 * (8 - L))
+        got = _u64_dictionary_bytes(np.sort(vals), L)
+        back = (8 * np.arange(7, 7 - L, -1, dtype=np.int64)).astype(np.uint64)
+        ub = ((np.sort(vals)[:, None] >> back[None, :]) & np.uint64(0xFF)).astype(np.uint8)
+        want = np.ascontiguousarray(ub).view(f"S{L}").ravel()
+        assert (got == want).all(), L
