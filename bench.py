@@ -57,8 +57,8 @@ Env knobs: CSVPLUS_BENCH_ROWS (override the auto-sized order count),
 CSVPLUS_BENCH_CUSTOMERS (100_000), CSVPLUS_BENCH_PRODUCTS (1_000),
 CSVPLUS_BENCH_HOST_SAMPLE (200_000), CSVPLUS_BENCH_REPS (5),
 CSVPLUS_BENCH_BUDGET (540 s), CSVPLUS_BENCH_TIER_DEADLINE (120 s),
-CSVPLUS_BENCH_PROBE_BACKOFF (20 s), CSVPLUS_BENCH_GO_PROXY (=0 skips
-the C++ proxy).
+CSVPLUS_BENCH_PROBE_TIMEOUT (45 s per probe), CSVPLUS_BENCH_PROBE_BACKOFF
+(20 s), CSVPLUS_BENCH_GO_PROXY (=0 skips the C++ proxy).
 """
 
 from __future__ import annotations

@@ -291,8 +291,8 @@ class StringColumn:
         lanes = lanes_for_width(width)
         if lanes is not None:
             return tuple(jax.device_put(l) for l in pack_host(d, lanes)), None
-        if d.dtype.kind != "S":
-            d = d.astype("S")
+        # host dictionaries are always 'S' bytes arrays (encode_strings
+        # invariant), so byte lengths come straight from str_len
         keep = np.char.str_len(d) <= MAX_LANE_BYTES
         pos = np.flatnonzero(keep).astype(np.int32)
         sub = d[keep].astype(f"S{MAX_LANE_BYTES}")

@@ -206,16 +206,25 @@ int64_t csv_scan(const char* buf, int64_t len, char delim, char comment,
 
 // how many records were produced before an error / at success is carried
 // via err_record; a second entry point reports the record count for
-// convenience when pre-sizing is needed.
+// convenience when pre-sizing is needed.  flags_out also reports byte
+// presence in the same single pass (bit0 quote, bit1 CR, bit2 comment
+// char) so the simple-scan gate needs no extra full-buffer scans.
 int64_t csv_count_bounds(const char* buf, int64_t len, char delim,
-                         int64_t* max_fields_out, int64_t* max_records_out) {
+                         char comment, int64_t* max_fields_out,
+                         int64_t* max_records_out, int64_t* flags_out) {
   int64_t d = 0, nl = 0;
+  int64_t flags = 0;
   for (int64_t i = 0; i < len; i++) {
-    if (buf[i] == delim) d++;
-    else if (buf[i] == '\n') nl++;
+    const char c = buf[i];
+    if (c == delim) d++;
+    else if (c == '\n') nl++;
+    else if (c == '"') flags |= 1;
+    else if (c == '\r') flags |= 2;
+    if (c == comment) flags |= 4;
   }
   *max_fields_out = d + nl + 2;
   *max_records_out = nl + 2;
+  *flags_out = flags;
   return 0;
 }
 

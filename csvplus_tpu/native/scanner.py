@@ -73,6 +73,8 @@ def _load():
             ctypes.c_void_p,
             ctypes.c_int64,
             ctypes.c_char,
+            ctypes.c_char,
+            ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64),
         ]
@@ -161,30 +163,28 @@ def scan_bytes(
     base = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p).value + offset
     max_fields = ctypes.c_int64(0)
     max_records = ctypes.c_int64(0)
+    flags = ctypes.c_int64(0)
+    comment_b = (comment or "\x00").encode("utf-8")[0:1]
     lib.csv_count_bounds(
         base,
         n,
         delimiter.encode("utf-8"),
+        comment_b,
         ctypes.byref(max_fields),
         ctypes.byref(max_records),
+        ctypes.byref(flags),
     )
     mf, mr = max_fields.value, max_records.value
     starts = np.empty(mf, dtype=np.int64)
     lens = np.empty(mf, dtype=np.int32)
     counts = np.empty(mr, dtype=np.int32)
 
-    # SIMPLE fast path: no quotes, no CR, no comment bytes in range —
-    # the SWAR tokenizer applies (~4x the state machine's throughput),
-    # no scratch buffer exists, and no parse error is possible
-    if (
-        data.find(b'"', offset, offset + n) < 0
-        and data.find(b"\r", offset, offset + n) < 0
-        and (
-            comment is None
-            or len(comment.encode("utf-8")) != 1
-            or data.find(comment.encode("utf-8"), offset, offset + n) < 0
-        )
-    ):
+    # SIMPLE fast path: no quote / CR / comment bytes in range (flags
+    # from the same single counting pass) — the SWAR tokenizer applies
+    # (~4x the state machine's throughput), no scratch buffer exists,
+    # and no parse error is possible
+    no_comment = comment is None or (flags.value & 4) == 0
+    if (flags.value & 3) == 0 and no_comment:
         nrec = ctypes.c_int64(0)
         total = int(
             lib.csv_scan_simple(
