@@ -183,7 +183,14 @@ def scan_bytes(
     # from the same single counting pass) — the SWAR tokenizer applies
     # (~4x the state machine's throughput), no scratch buffer exists,
     # and no parse error is possible
-    no_comment = comment is None or (flags.value & 4) == 0
+    # a multi-byte comment can't be honored by either native scanner
+    # (library callers gate it upstream); keep the old direct-call
+    # semantics: it does NOT disqualify the simple path
+    no_comment = (
+        comment is None
+        or len(comment.encode("utf-8")) != 1
+        or (flags.value & 4) == 0
+    )
     if (flags.value & 3) == 0 and no_comment:
         nrec = ctypes.c_int64(0)
         total = int(

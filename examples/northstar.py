@@ -38,6 +38,9 @@ def generate(n_orders: int) -> str:
     """Write orders/customers/products CSVs (cached across runs)."""
     os.makedirs(DATA_DIR, exist_ok=True)
     opath = os.path.join(DATA_DIR, f"orders_{n_orders}_v2.csv")  # v2: +order_id
+    old = os.path.join(DATA_DIR, f"orders_{n_orders}.csv")
+    if os.path.exists(old):
+        os.remove(old)  # pre-v2 cache: don't leak GBs in the data dir
     cpath = os.path.join(DATA_DIR, "customers.csv")
     ppath = os.path.join(DATA_DIR, "products.csv")
     if not os.path.exists(cpath):
