@@ -338,6 +338,8 @@ int64_t csv_scan_simple(const char* buf, int64_t len, char delim,
 // exceeds max_k — high-cardinality columns bail to the sort path, so
 // the probe table stays small and cache-resident for the low-
 // cardinality columns this exists for.
+}  // extern "C" — reopened below for the hash-encode wrappers
+
 // splitmix64-style finalizer: every input bit affects every output bit.
 // Packed fields carry their bytes big-endian (short values vary ONLY in
 // the high bits), so a plain multiply-shift hash would drop exactly the
@@ -351,24 +353,30 @@ static inline uint64_t mix64(uint64_t h) {
   return h;
 }
 
-int64_t csv_encode_hash_u64(const uint64_t* packed, int64_t n,
-                            uint64_t* uniq_out, int32_t* prov_codes,
-                            int64_t max_k) {
-  // Start small and double (load kept <= 1/2): a 5-distinct column on a
-  // 100M-row file probes a cache-resident 64K-slot table, never a
-  // max_k-sized one.  `limit` bounds growth; hitting max_k inserts bails.
-  int64_t limit = 1 << 16;
+namespace {
+
+// ONE open-addressing hash-encode core shared by the 1-lane and 2-lane
+// entry points (a review found the two hand-copied variants drifting).
+// Starts at a cache-resident 64K-slot table and rehash-doubles with the
+// load kept <= 1/2; returns the distinct count, or -1 once max_k
+// distinct values have been seen (the caller bails to a sort encode).
+// `load(i)` yields row i's key; `store(k, key)` records distinct #k in
+// first-seen order; prov_codes[i] gets row i's provisional code.
+template <typename K, typename Load, typename Store>
+int64_t hash_encode_core(int64_t n, int64_t max_k, Load load, Store store,
+                         int32_t* prov_codes) {
+  int64_t limit = 1 << 16;  // never below the starting capacity
   while (limit < 2 * max_k) limit <<= 1;
-  int64_t cap = limit < (1 << 16) ? limit : (1 << 16);
-  uint64_t* keys = new uint64_t[cap];
+  int64_t cap = 1 << 16;
+  K* keys = new K[cap];
   int32_t* slots = new int32_t[cap];
   memset(slots, 0xFF, (size_t)cap * sizeof(int32_t));  // -1 = empty
   uint64_t mask = (uint64_t)cap - 1;
   int64_t grow_at = cap >> 1;
   int64_t k = 0;
   for (int64_t i = 0; i < n; ++i) {
-    const uint64_t v = packed[i];
-    uint64_t j = mix64(v) & mask;
+    const K v = load(i);
+    uint64_t j = v.hash() & mask;
     for (;;) {
       const int32_t s = slots[j];
       if (s < 0) {
@@ -379,7 +387,7 @@ int64_t csv_encode_hash_u64(const uint64_t* packed, int64_t n,
         }
         slots[j] = (int32_t)k;
         keys[j] = v;
-        uniq_out[k] = v;
+        store(k, v);
         prov_codes[i] = (int32_t)k;
         k++;
         break;
@@ -392,13 +400,13 @@ int64_t csv_encode_hash_u64(const uint64_t* packed, int64_t n,
     }
     if (k >= grow_at && cap < limit) {  // rehash-double
       const int64_t ncap = cap << 1;
-      uint64_t* nkeys = new uint64_t[ncap];
+      K* nkeys = new K[ncap];
       int32_t* nslots = new int32_t[ncap];
       memset(nslots, 0xFF, (size_t)ncap * sizeof(int32_t));
       const uint64_t nmask = (uint64_t)ncap - 1;
       for (int64_t o = 0; o < cap; ++o) {
         if (slots[o] < 0) continue;
-        uint64_t j2 = mix64(keys[o]) & nmask;
+        uint64_t j2 = keys[o].hash() & nmask;
         while (nslots[j2] >= 0) j2 = (j2 + 1) & nmask;
         nslots[j2] = slots[o];
         nkeys[j2] = keys[o];
@@ -415,6 +423,48 @@ int64_t csv_encode_hash_u64(const uint64_t* packed, int64_t n,
   delete[] keys;
   delete[] slots;
   return k;
+}
+
+struct Key1 {
+  uint64_t v;
+  bool operator==(const Key1& o) const { return v == o.v; }
+  uint64_t hash() const { return mix64(v); }
+};
+
+struct Key2 {
+  uint64_t h, l;
+  bool operator==(const Key2& o) const { return h == o.h && l == o.l; }
+  uint64_t hash() const { return mix64(h ^ mix64(l)); }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Hash-based dictionary encode for u64-packed (<= 8 byte) fields:
+// provisional codes in first-seen order; the caller sorts the distinct
+// set and rank-remaps.  -1 = bailed past max_k distinct.
+int64_t csv_encode_hash_u64(const uint64_t* packed, int64_t n,
+                            uint64_t* uniq_out, int32_t* prov_codes,
+                            int64_t max_k) {
+  return hash_encode_core<Key1>(
+      n, max_k, [&](int64_t i) { return Key1{packed[i]}; },
+      [&](int64_t k, const Key1& v) { uniq_out[k] = v.v; }, prov_codes);
+}
+
+// Two-lane variant for 9..16-byte fields packed as big-endian (hi, lo)
+// u64 pairs.
+int64_t csv_encode_hash_u64x2(const uint64_t* hi, const uint64_t* lo,
+                              int64_t n, uint64_t* uniq_hi,
+                              uint64_t* uniq_lo, int32_t* prov_codes,
+                              int64_t max_k) {
+  return hash_encode_core<Key2>(
+      n, max_k, [&](int64_t i) { return Key2{hi[i], lo[i]}; },
+      [&](int64_t k, const Key2& v) {
+        uniq_hi[k] = v.h;
+        uniq_lo[k] = v.l;
+      },
+      prov_codes);
 }
 
 }  // extern "C"

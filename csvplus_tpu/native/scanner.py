@@ -122,6 +122,16 @@ def _load():
             ctypes.c_void_p,
             ctypes.c_int64,
         ]
+        lib.csv_encode_hash_u64x2.restype = ctypes.c_int64
+        lib.csv_encode_hash_u64x2.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+        ]
         lib.csv_u64_to_bytes.restype = None
         lib.csv_u64_to_bytes.argtypes = [
             ctypes.c_void_p,
@@ -410,6 +420,20 @@ def encode_fields_vectorized(
             uniq64, codes = _encode_u64(packed)
         dictionary = _u64_dictionary_bytes(uniq64, L)
         return dictionary, codes.ravel().astype(np.int32)
+    if L <= 16:
+        mat = _pack_fields_native(combined, starts, lens, 16)
+        if mat is not None:
+            be = mat.view(">u8")
+            hi = be[:, 0].astype(np.uint64)
+            lo = be[:, 1].astype(np.uint64)
+            (uh, ul), codes = _encode_u64x2(hi, lo)
+            pair = np.empty((uh.size, 2), dtype=">u8")
+            pair[:, 0] = uh
+            pair[:, 1] = ul
+            dictionary = np.frombuffer(pair.tobytes(), dtype="S16").astype(
+                f"S{L}"
+            )
+            return dictionary, codes.ravel().astype(np.int32)
     mat = _pack_fields_native(combined, starts, lens, L)
     if mat is None:
         mat = _gather_numpy(combined, starts, lens, L)
@@ -447,6 +471,55 @@ def _encode_u64(packed: np.ndarray):
         rank[order] = np.arange(k, dtype=np.int32)
         return d[order], rank[prov]
     return np.unique(packed, return_inverse=True)  # high cardinality
+
+
+def _encode_u64x2(hi: np.ndarray, lo: np.ndarray):
+    """Dictionary-encode (hi, lo) big-endian u64 lane pairs (9-16 byte
+    fields): C++ two-lane hash encode first, lexsort on bail — measured
+    ~4.5x the void-dtype np.unique this replaces (the round-4 northstar
+    profile's order_id-class cost).  Pair order == padded byte order, so
+    codes stay order-preserving."""
+    n = hi.shape[0]
+
+    def _lex_unique():
+        order = np.lexsort((lo, hi))
+        sh, sl = hi[order], lo[order]
+        new = np.empty(n, dtype=bool)
+        new[0] = True
+        np.logical_or(sh[1:] != sh[:-1], sl[1:] != sl[:-1], out=new[1:])
+        ranks = (np.cumsum(new) - 1).astype(np.int32)
+        codes = np.empty(n, dtype=np.int32)
+        codes[order] = ranks
+        return (sh[new], sl[new]), codes
+
+    try:
+        lib = _load()
+    except ImportError:
+        return _lex_unique()
+    max_k = max(1024, n // 4)
+    uh = np.empty(max_k, dtype=np.uint64)
+    ul = np.empty(max_k, dtype=np.uint64)
+    prov = np.empty(n, dtype=np.int32)
+    # bind to locals: an inline ascontiguousarray temporary could be
+    # freed before the native call runs
+    hi_c = np.ascontiguousarray(hi)
+    lo_c = np.ascontiguousarray(lo)
+    k = lib.csv_encode_hash_u64x2(
+        hi_c.ctypes.data,
+        lo_c.ctypes.data,
+        n,
+        uh.ctypes.data,
+        ul.ctypes.data,
+        prov.ctypes.data,
+        max_k,
+    )
+    if k < 0:
+        return _lex_unique()
+    dh, dl = uh[:k], ul[:k]
+    lex = np.lexsort((dl, dh))
+    rank = np.empty(k, dtype=np.int32)
+    rank[lex] = np.arange(k, dtype=np.int32)
+    return (dh[lex], dl[lex]), rank[prov]
 
 
 def _u64_dictionary_bytes(uniq64: np.ndarray, L: int) -> np.ndarray:
@@ -792,7 +865,11 @@ def stream_encoded_chunks(
             if b"\x00" in data:
                 raise StreamFallback("NUL in chunk")
             try:
-                starts, lens, counts, scratch = scan_bytes(
+                # chunks start at record boundaries with closed quote
+                # state, so the multi-threaded newline-split scan applies
+                # to them exactly as to whole files (quote-bearing chunks
+                # fall back to the single-pass state machine inside)
+                starts, lens, counts, scratch = scan_bytes_parallel(
                     data,
                     delimiter=reader._delimiter,
                     comment=reader._comment,

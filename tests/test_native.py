@@ -329,3 +329,38 @@ def test_u64_dictionary_bytes_matches_numpy():
         ub = ((np.sort(vals)[:, None] >> back[None, :]) & np.uint64(0xFF)).astype(np.uint8)
         want = np.ascontiguousarray(ub).view(f"S{L}").ravel()
         assert (got == want).all(), L
+
+
+def test_wide_field_two_lane_encode_differential():
+    """9-16 byte fields route through the (hi, lo) u64-pair encode (hash
+    tier + lexsort bail) and must match np.unique on the raw values
+    exactly, across cardinalities and widths incl. the 16-byte cap."""
+    import numpy as np
+
+    from csvplus_tpu.native.scanner import encode_fields_vectorized
+
+    rng = np.random.default_rng(3)
+    for trial, (width, card) in enumerate(
+        [(9, 50), (12, 10_000), (16, None), (10, None), (9, 3)]
+    ):
+        n = 30_000
+        if card:
+            pool = np.array(
+                [f"{'v' * (width - 6)}{i:06d}".encode() for i in range(card)],
+                dtype="S",
+            )
+            vals = pool[rng.integers(0, card, n)]
+        else:
+            vals = np.char.add(
+                "u" * (width - 8),
+                np.char.zfill(np.arange(n).astype(np.str_), 8),
+            ).astype("S")
+        body = b"\n".join(vals.tolist()) + b"\n"
+        combined = np.frombuffer(body, dtype=np.uint8)
+        lens_arr = np.char.str_len(vals).astype(np.int32)
+        starts = np.zeros(n, dtype=np.int64)
+        starts[1:] = np.cumsum(lens_arr[:-1] + 1)
+        d, codes = encode_fields_vectorized(combined, starts, lens_arr)
+        want_d, want_c = np.unique(vals, return_inverse=True)
+        assert (d.astype(want_d.dtype) == want_d).all(), trial
+        assert (codes == want_c).all(), trial
