@@ -92,8 +92,29 @@ def lookup_code(dictionary: np.ndarray, value: str) -> int:
     return -1
 
 
+class _LaneState:
+    """Shared mutable state of one device-lane dictionary.
+
+    ``with_codes``/``gather``/``with_sharding`` copies of a column all
+    point at the SAME state, so the deferred union sort
+    (:meth:`StringColumn._ensure_sorted_lanes`) runs once globally:
+    after the first settle, ``trans`` (old slot -> sorted slot) lets
+    every other copy remap its codes with one cheap gather instead of
+    re-sorting the full dictionary."""
+
+    __slots__ = ("lanes", "sorted", "trans")
+
+    def __init__(self, lanes: tuple, sorted_: bool):
+        self.lanes = lanes
+        self.sorted = sorted_
+        self.trans = None
+
+
 class StringColumn:
     """One dictionary-encoded string column.
+
+    (See :class:`_LaneState` for the shared deferred-sort state of
+    device-lane dictionaries.)
 
     The dictionary normally lives on host (sorted 'S' bytes).  HIGH-
     CARDINALITY columns may instead carry it on DEVICE as sign-flipped
@@ -112,15 +133,52 @@ class StringColumn:
         _has_absent: "bool | None" = None,  # lazy cache: any absent cells?
         _str_dict: "np.ndarray | None" = None,  # lazy cache: decoded dict
         _codes_host: "np.ndarray | None" = None,  # lazy cache: host codes
-        dev_dictionary: "tuple | None" = None,  # sorted int32 lanes, device
+        dev_dictionary: "tuple | None" = None,  # int32 lanes, device
+        dev_dict_sorted: bool = True,  # False: unsorted concat, may hold dups
+        _lane_state: "_LaneState | None" = None,  # share with sibling copies
     ):
-        assert dictionary is not None or dev_dictionary is not None
+        assert dictionary is not None or dev_dictionary is not None or (
+            _lane_state is not None
+        )
         self._dictionary = dictionary
         self.codes = codes
         self._has_absent = _has_absent
         self._str_dict = _str_dict
         self._codes_host = _codes_host
-        self.dev_dictionary = dev_dictionary
+        # streamed ingest defers the global dictionary sort: an UNSORTED
+        # lane dictionary (concatenated chunk dictionaries, codes offset
+        # per chunk) decodes/gathers/checksums fine, but anything that
+        # relies on code order == value order or one-value-one-code
+        # (find_code, joins, sorts, host materialization, persistence)
+        # must call _ensure_sorted_lanes() first.  The lane state is
+        # SHARED between with_codes/gather/with_sharding copies so the
+        # global sort runs once; each copy then remaps its own codes
+        # with one cheap gather.
+        if _lane_state is not None:
+            self._lane_state = _lane_state
+        elif dev_dictionary is not None:
+            self._lane_state = _LaneState(dev_dictionary, dev_dict_sorted)
+        else:
+            self._lane_state = None
+        # True when self.codes index the CURRENT (settled) lane order.
+        # A copy sharing a state that a sibling later settles keeps its
+        # own flag False until its codes are remapped.
+        self._dev_dict_sorted = (
+            dev_dict_sorted if self._lane_state is not None else True
+        )
+
+    @property
+    def dev_dictionary(self) -> "tuple | None":
+        """The device lane dictionary, coherent with ``self.codes``: if a
+        sibling copy already settled the shared state, this column's
+        codes remap (cheap gather, no sort) before the lanes are
+        exposed."""
+        st = self._lane_state
+        if st is None:
+            return None
+        if st.sorted and not self._dev_dict_sorted:
+            self._ensure_sorted_lanes()  # remap-only: the sort already ran
+        return st.lanes
 
     @property
     def dictionary(self) -> np.ndarray:
@@ -129,17 +187,63 @@ class StringColumn:
         if self._dictionary is None:
             from ..ops.lanes import unpack_host
 
+            self._ensure_sorted_lanes()
             self._dictionary = unpack_host(
-                [np.asarray(l) for l in self.dev_dictionary]
+                [np.asarray(l) for l in self._lane_state.lanes]
             )
         return self._dictionary
 
+    def _ensure_sorted_lanes(self) -> None:
+        """Sort + dedupe a deferred (unsorted-concat) lane dictionary ON
+        DEVICE and remap this column's codes to the dense sorted slots —
+        the lazy form of the streamed tier's dictionary union.  The sort
+        runs ONCE per shared lane state (copies remap with one gather);
+        columns only ever decoded/gathered/checksummed via the shared
+        state never pay it (the round-4 northstar profile's dominant
+        ingest cost was exactly this sort, paid eagerly for a payload
+        column that never needed it)."""
+        st = self._lane_state
+        if st is None or self._dev_dict_sorted:
+            return
+        from ..utils.observe import telemetry
+
+        if not st.sorted:
+            from ..ops.lanes import union_device
+
+            with telemetry.stage(
+                "lane-dict:deferred-sort", int(st.lanes[0].shape[0])
+            ):
+                union, (trans,) = union_device([st.lanes])
+                st.lanes = union
+                st.trans = trans
+                st.sorted = True
+        trans = st.trans
+        sh = getattr(self.codes, "sharding", None)
+        if sh is not None and len(getattr(sh, "device_set", ())) > 1:
+            # mesh-sharded codes: replicate the translation table onto
+            # the codes' mesh so the remap gather is placement-legal
+            trans = jax.device_put(
+                trans,
+                jax.sharding.NamedSharding(sh.mesh, jax.sharding.PartitionSpec()),
+            )
+        self.codes = jnp.where(
+            self.codes >= 0,
+            jnp.take(trans, jnp.clip(self.codes, 0), axis=0),
+            self.codes,
+        )
+        self._codes_host = None  # host mirror (if any) is stale
+        self._dev_dict_sorted = True
+
     @property
     def dict_size(self) -> int:
-        """Distinct-value count WITHOUT forcing host materialization."""
+        """Dictionary slot count WITHOUT forcing host materialization.
+        Equals the distinct-value count once the lane state is settled;
+        a DEFERRED (unsorted-concat) lane dictionary may overcount
+        (duplicates across chunks) — code-order consumers settle via
+        :meth:`_ensure_sorted_lanes` before sizing bit packs from this."""
         if self._dictionary is not None:
             return int(self._dictionary.size)
-        return int(self.dev_dictionary[0].shape[0])
+        return int(self._lane_state.lanes[0].shape[0])
 
     def find_code(self, value: str) -> int:
         """Dictionary slot of *value* or -1 — the device lane search for
@@ -158,6 +262,7 @@ class StringColumn:
         key = value.encode("utf-8")
         if len(key) > MAX_LANE_BYTES:
             return -1  # wider than any lane-dictionary entry can be
+        self._ensure_sorted_lanes()  # the lane search needs sorted order
         n_lanes = len(self.dev_dictionary)
         if lanes_for_width(len(key)) > n_lanes:
             return -1  # longer than every stored entry: cannot match
@@ -199,6 +304,7 @@ class StringColumn:
         buys microsecond lookups, instead of a device gather + download
         round trip per find."""
         if self._codes_host is None:
+            self._ensure_sorted_lanes()  # mirror must be post-remap
             self._codes_host = np.asarray(self.codes)
         return self._codes_host
 
@@ -224,7 +330,10 @@ class StringColumn:
         this column is known fully present (a subset of a fully-present
         column is fully present)."""
         out = StringColumn(
-            self._dictionary, codes, dev_dictionary=self.dev_dictionary
+            self._dictionary,
+            codes,
+            dev_dict_sorted=self._dev_dict_sorted,
+            _lane_state=self._lane_state,
         )
         out._str_dict = self._str_dict
         if self._has_absent is False:
@@ -246,6 +355,10 @@ class StringColumn:
         absent cells (negative codes, incl. the -2 sharding pad) become
         None.  The single definition of host-side code decoding, shared
         by :meth:`decode` and :meth:`DeviceTable.rows_from_mirror`.
+
+        CALLER CONTRACT: *codes* must be snapshotted AFTER
+        ``_ensure_sorted_lanes()`` (``decode``/``codes_host`` do this),
+        because the deferred lane-dictionary sort remaps the code space.
 
         Small slices (point lookups) decode only the matched dictionary
         entries: decoding a 1M-entry dictionary to serve a 10-row
@@ -272,6 +385,7 @@ class StringColumn:
 
     def decode(self) -> List[Optional[str]]:
         """Materialize values on host; absent cells become None."""
+        self._ensure_sorted_lanes()  # BEFORE the code snapshot below
         return self.decode_codes(np.asarray(self.codes))
 
     def _lanes_narrow(self) -> "tuple":
@@ -283,6 +397,7 @@ class StringColumn:
         the caller can remap subset slots back to full slots) instead of
         failing the whole join."""
         if self.dev_dictionary is not None:
+            self._ensure_sorted_lanes()  # translation assumes sorted lanes
             return self.dev_dictionary, None
         from ..ops.lanes import MAX_LANE_BYTES, lanes_for_width, pack_host
 
@@ -507,7 +622,8 @@ class DeviceTable:
             moved = StringColumn(
                 col._dictionary,
                 jax.device_put(codes, sharding),
-                dev_dictionary=col.dev_dictionary,
+                dev_dict_sorted=col._dev_dict_sorted,
+                _lane_state=col._lane_state,
             )
             moved._str_dict = col._str_dict
             moved._has_absent = col._has_absent if not pad else None

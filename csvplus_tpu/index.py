@@ -466,6 +466,7 @@ class Index:
         arrays: "dict[str, np.ndarray]" = {}
         for name, col in table.columns.items():
             if col.dev_dictionary is not None and col._dictionary is None:
+                col._ensure_sorted_lanes()  # v3 stores SORTED lane arrays
                 lane_columns[name] = len(col.dev_dictionary)
                 for i, lane in enumerate(col.dev_dictionary):
                     arrays[f"l{i}:{name}"] = np.asarray(lane)

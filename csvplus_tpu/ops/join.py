@@ -226,6 +226,10 @@ class DeviceIndex:
     def build(cls, table: DeviceTable, key_columns: Sequence[str]) -> "DeviceIndex":
         key_columns = list(key_columns)
         cols = [table.columns[c] for c in key_columns]
+        for c in cols:
+            # packed keys assume code order == value order and one code
+            # per value; deferred-union lane dictionaries settle here
+            c._ensure_sorted_lanes()
         bits = [_bits_for(c.dict_size) for c in cols]
         total = sum(bits)
         if total > 62:

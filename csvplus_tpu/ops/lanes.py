@@ -102,6 +102,15 @@ def extend_lanes_host(lane_arrays: "List[np.ndarray]", lanes: int):
     return list(lane_arrays) + [fill] * (lanes - len(lane_arrays))
 
 
+def widen_lanes_device(lanes: Tuple, n_lanes: int) -> Tuple:
+    """The device form of :func:`extend_lanes_host` — the ONE definition
+    of the packed-NUL fill convention for device lane tuples."""
+    if len(lanes) >= n_lanes:
+        return tuple(lanes)
+    fill = jnp.full(lanes[0].shape[0], _SIGN, jnp.int32)
+    return tuple(lanes) + (fill,) * (n_lanes - len(lanes))
+
+
 def searchsorted_lanes(keys: Tuple, qs: Tuple, side: str = "left"):
     """Vectorized binary search over k sign-flipped lane tuples —
     branchless, static trip count, lexicographic compare across lanes
@@ -167,12 +176,7 @@ def union_device(
     chunk slot -> union slot).  The only host sync is the union SIZE
     (one scalar, needed for the static output slice)."""
     n_lanes = max(len(c) for c in chunk_lanes)
-    widened = []
-    for c in chunk_lanes:
-        if len(c) < n_lanes:
-            fill = jnp.full(c[0].shape[0], _SIGN, jnp.int32)
-            c = tuple(c) + (fill,) * (n_lanes - len(c))
-        widened.append(tuple(c))
+    widened = [widen_lanes_device(c, n_lanes) for c in chunk_lanes]
     sizes = [int(c[0].shape[0]) for c in widened]
     k_real = sum(sizes)
     k_pad = max(1 << max(k_real - 1, 0).bit_length(), 1)
@@ -213,11 +217,7 @@ def translate_lanes(build_lanes: Tuple, query_lanes: Tuple) -> jax.Array:
     """Translation table between two sorted lane dictionaries, device-
     resident; lane counts are reconciled by widening the narrower."""
     n_lanes = max(len(build_lanes), len(query_lanes))
-
-    def widen(lanes):
-        if len(lanes) < n_lanes:
-            fill = jnp.full(lanes[0].shape[0], _SIGN, jnp.int32)
-            lanes = tuple(lanes) + (fill,) * (n_lanes - len(lanes))
-        return tuple(lanes)
-
-    return _translate_kernel(widen(build_lanes), widen(query_lanes))
+    return _translate_kernel(
+        widen_lanes_device(build_lanes, n_lanes),
+        widen_lanes_device(query_lanes, n_lanes),
+    )

@@ -93,6 +93,10 @@ def sort_table(table: DeviceTable, key_columns: Sequence[str]) -> DeviceTable:
     (SURVEY §2 "index build (distributed)"; the semantics anchor is the
     reference's whole-dataset sort, csvplus.go:722-736)."""
     key_cols = [table.columns[c] for c in key_columns]
+    for c in key_cols:
+        # sorting BY a column requires code order == value order; a
+        # deferred-union lane dictionary settles here (no-op otherwise)
+        c._ensure_sorted_lanes()
     if table.nrows >= DSORT_MIN_ROWS:
         mesh = _sharded_mesh(key_cols)
         # packed lanes require real codes in every key cell; the index

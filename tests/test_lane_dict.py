@@ -222,3 +222,81 @@ def test_lane_index_persistence_roundtrip(highcard_csv, tmp_path):
         assert loaded.find(probe).to_rows() == idx.find(probe).to_rows()
     # full equality through a sink boundary
     assert Take(loaded).to_rows() == Take(idx).to_rows()
+
+
+def test_deferred_union_payload_column_never_sorts(tmp_path, monkeypatch):
+    """A multi-chunk lane column used ONLY as payload (decode/checksum/
+    gather) must never pay the global dictionary union sort; keying on
+    it triggers the deferred sort exactly once with identical results."""
+    monkeypatch.setenv("CSVPLUS_STREAM_MIN_BYTES", "1")
+    monkeypatch.setenv("CSVPLUS_STREAM_CHUNK_BYTES", "2048")
+    monkeypatch.setenv("CSVPLUS_DICT_DEVICE_MIN_DISTINCT", "1")
+    p = tmp_path / "o.csv"
+    p.write_text(
+        "order_id,cust,qty\n"
+        + "".join(f"ord-{i:06d},c{i % 7},{i % 5}\n" for i in range(600))
+    )
+    from csvplus_tpu.columnar.exec import execute_plan
+    from csvplus_tpu.utils.checksum import checksum_device_table, checksum_host_rows
+    from csvplus_tpu.utils.observe import telemetry
+
+    host_rows = Take(from_file(str(p))).to_rows()
+
+    # payload-only: checksum + join keyed on ANOTHER column
+    with telemetry.collect() as records:
+        table = execute_plan(from_file(str(p)).on_device().plan)
+        col = table.columns["order_id"]
+        assert col.dev_dictionary is not None and not col._dev_dict_sorted
+        sums = checksum_device_table(table, ["order_id"], positional=True)
+        assert sums == checksum_host_rows(host_rows, ["order_id"], positional=True)
+        assert not col._dev_dict_sorted  # checksum did not sort it
+    assert not any(r.stage == "lane-dict:deferred-sort" for r in records)
+
+    # decoding DOES settle the dictionary (host materialization path)
+    assert from_file(str(p)).on_device().to_rows() == host_rows
+
+    # keying on the deferred column sorts it lazily, once, correctly
+    with telemetry.collect() as records:
+        idx = from_file(str(p)).on_device().unique_index_on("order_id")
+        host_idx = Take(from_file(str(p))).unique_index_on("order_id")
+        assert idx.find("ord-000123").to_rows() == host_idx.find("ord-000123").to_rows()
+    # one deferred sort per lane column at most (threshold=1 makes all
+    # three columns lane-mode here: the key settles at sort_table, the
+    # payloads at the find's host decode)
+    n_sorts = sum(r.stage == "lane-dict:deferred-sort" for r in records)
+    assert 1 <= n_sorts <= 3
+    # filters on the deferred column too
+    got = from_file(str(p)).on_device().filter(Like({"order_id": "ord-000007"})).to_rows()
+    want = Take(from_file(str(p))).filter(Like({"order_id": "ord-000007"})).to_rows()
+    assert got == want and len(got) == 1
+
+
+def test_deferred_lanes_survive_mesh_sharding(tmp_path, monkeypatch):
+    """A DEFERRED lane column carried through with_sharding must settle
+    correctly against mesh-sharded codes (the translation table is
+    replicated onto the codes' mesh): stream -> shard -> key on the
+    lane column -> results match host (review r4 regression)."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        import pytest
+
+        pytest.skip("needs a multi-device mesh")
+    monkeypatch.setenv("CSVPLUS_STREAM_MIN_BYTES", "1")
+    monkeypatch.setenv("CSVPLUS_STREAM_CHUNK_BYTES", "2048")
+    monkeypatch.setenv("CSVPLUS_DICT_DEVICE_MIN_DISTINCT", "1")
+    p = tmp_path / "o.csv"
+    p.write_text(
+        "order_id,cust,qty\n"
+        + "".join(f"ord-{i:06d},c{i % 7},{i % 5}\n" for i in range(640))
+    )
+    dev = from_file(str(p)).on_device(shards=len(jax.devices()))
+    col = dev.plan.table.columns["order_id"]
+    assert col._lane_state is not None and not col._dev_dict_sorted
+    # key on the deferred lane column over sharded codes
+    idx = dev.unique_index_on("order_id")
+    host_idx = Take(from_file(str(p))).unique_index_on("order_id")
+    assert len(idx) == 640
+    assert idx.find("ord-000321").to_rows() == host_idx.find("ord-000321").to_rows()
+    # and full decode parity through the sharded path
+    assert dev.to_rows() == Take(from_file(str(p))).to_rows()
