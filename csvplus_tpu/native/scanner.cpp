@@ -257,6 +257,24 @@ void csv_pack_fields_u64(const char* buf, const int64_t* starts,
   }
 }
 
+// CSV body assembly: scatter one column's escaped dictionary entries
+// into a pre-sized row-major output buffer, appending `sep` after each
+// field (',' mid-row, '\n' for the last column).  The caller computes
+// per-row byte starts vectorized (dictionary entry lengths gathered by
+// code + exclusive scan across columns); this loop is one memcpy per
+// cell with zero Python objects.
+void csv_scatter_fields(const char* blob, const int64_t* dict_off,
+                        const int32_t* dict_len, const int32_t* codes,
+                        const int64_t* starts, int64_t n, char sep,
+                        char* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t c = codes[i];
+    const int32_t l = dict_len[c];
+    memcpy(out + starts[i], blob + dict_off[c], (size_t)l);
+    out[starts[i] + l] = sep;
+  }
+}
+
 // Unpack k big-endian-packed u64 dictionary values into NUL-padded
 // fixed-width byte rows (the 'S{width}' dictionary array) — replaces a
 // numpy (k, width) shift-and-mask broadcast that dominated the encode

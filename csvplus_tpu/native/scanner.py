@@ -132,6 +132,17 @@ def _load():
             ctypes.c_void_p,
             ctypes.c_int64,
         ]
+        lib.csv_scatter_fields.restype = None
+        lib.csv_scatter_fields.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.c_char,
+            ctypes.c_void_p,
+        ]
         lib.csv_u64_to_bytes.restype = None
         lib.csv_u64_to_bytes.argtypes = [
             ctypes.c_void_p,

@@ -171,3 +171,34 @@ def test_save_temps_knob(tmp_path, monkeypatch, corpus):
     _os.makedirs(dest, exist_ok=True)
     shutil.copy2(corpus["people_csv"], dest)
     assert (dest / "people.csv").exists()
+
+
+def test_csv_body_native_matches_numpy(monkeypatch):
+    """The C++ scatter assembly and the numpy fallback must stay
+    byte-identical (the fallback is otherwise dead code on any machine
+    with a toolchain)."""
+    from csvplus_tpu.columnar import csvenc
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    rows = []
+    for i in range(500):
+        rows.append(
+            Row(
+                {
+                    "a": f'q"uo,te{i}' if i % 7 == 0 else f"v{i % 37}",
+                    "b": "" if i % 11 == 0 else f"Zoë\n{i % 5}",
+                    "c": " lead" if i % 13 == 0 else str(i),
+                }
+            )
+        )
+    t = DeviceTable.from_rows(rows, device="cpu")
+    native = csvenc.encode_csv_body(t, ["a", "b", "c"])
+    monkeypatch.setattr(
+        csvenc, "_encode_csv_body_native", lambda nrows, cols: None
+    )
+    fallback = csvenc.encode_csv_body(t, ["a", "b", "c"])
+    assert native == fallback
+    # and both match the streaming writer
+    buf = io.StringIO()
+    TakeRows(rows).to_csv(buf, "a", "b", "c")
+    assert native == buf.getvalue().split("\n", 1)[1]
