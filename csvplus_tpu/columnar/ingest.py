@@ -22,6 +22,15 @@ from ..source import DataSource
 from .table import DeviceTable
 
 
+def _env_int(name: str, default: int) -> int:
+    """An int env knob; malformed values degrade to the default (never
+    abort an ingest over a typo'd tuning variable)."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 def source_from_table(table: DeviceTable) -> DataSource:
     """Plan-capable DataSource over an existing DeviceTable."""
     from .exec import plan_runner
@@ -123,8 +132,7 @@ def _stream_ingest_wanted(path: str) -> bool:
     CSVPLUS_STREAM_MIN_BYTES, 0 disables)."""
     import os
 
-    v = os.environ.get("CSVPLUS_STREAM_MIN_BYTES")
-    thresh = int(v) if v else _STREAM_MIN_BYTES
+    thresh = _env_int("CSVPLUS_STREAM_MIN_BYTES", _STREAM_MIN_BYTES)
     if thresh <= 0:
         return False
     try:
@@ -174,10 +182,8 @@ def _stream_to_table(reader, path: str, device) -> DeviceTable:
 
     dev = default_device(device)
     encoder = _device_chunk_encoder(dev) if _device_parse_enabled() else None
-    prefetch_depth = int(os.environ.get("CSVPLUS_STREAM_PREFETCH", "1"))
-    lane_thresh = int(
-        os.environ.get("CSVPLUS_DICT_DEVICE_MIN_DISTINCT", 4_000_000)
-    )
+    prefetch_depth = _env_int("CSVPLUS_STREAM_PREFETCH", 1)
+    lane_thresh = _env_int("CSVPLUS_DICT_DEVICE_MIN_DISTINCT", 4_000_000)
     names = None
     chunk_dicts: "dict[str, list]" = {}  # host mode: 'S' arrays
     chunk_lanes: "dict[str, list]" = {}  # lane mode: device lane tuples

@@ -20,6 +20,7 @@ strings are only materialized back on the host at the sink boundary.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -102,12 +103,16 @@ class _LaneState:
     every other copy remap its codes with one cheap gather instead of
     re-sorting the full dictionary."""
 
-    __slots__ = ("lanes", "sorted", "trans")
+    __slots__ = ("lanes", "sorted", "trans", "lock")
 
     def __init__(self, lanes: tuple, sorted_: bool):
         self.lanes = lanes
         self.sorted = sorted_
         self.trans = None
+        # sibling copies may settle concurrently (ingest runs a prefetch
+        # producer thread plus encode pools); the union sort + remap must
+        # be serialized so it runs once and trans is never read half-set
+        self.lock = threading.Lock()
 
 
 class StringColumn:
@@ -141,7 +146,6 @@ class StringColumn:
             _lane_state is not None
         )
         self._dictionary = dictionary
-        self.codes = codes
         self._has_absent = _has_absent
         self._str_dict = _str_dict
         self._codes_host = _codes_host
@@ -160,12 +164,24 @@ class StringColumn:
             self._lane_state = _LaneState(dev_dictionary, dev_dict_sorted)
         else:
             self._lane_state = None
-        # True when self.codes index the CURRENT (settled) lane order.
-        # A copy sharing a state that a sibling later settles keeps its
-        # own flag False until its codes are remapped.
-        self._dev_dict_sorted = (
-            dev_dict_sorted if self._lane_state is not None else True
+        # (codes, dev_dict_sorted) publish as ONE tuple: the flag is True
+        # when the codes index the CURRENT (settled) lane order, and a
+        # concurrent reader (with_codes/gather/with_sharding copying a
+        # column while a sibling settles on another thread) must never
+        # see a remapped codes array paired with a stale flag — a single
+        # attribute read is atomic under the GIL, two are not.
+        self._codes_state = (
+            codes,
+            dev_dict_sorted if self._lane_state is not None else True,
         )
+
+    @property
+    def codes(self) -> jax.Array:
+        return self._codes_state[0]
+
+    @property
+    def _dev_dict_sorted(self) -> bool:
+        return self._codes_state[1]
 
     @property
     def dev_dictionary(self) -> "tuple | None":
@@ -176,9 +192,18 @@ class StringColumn:
         st = self._lane_state
         if st is None:
             return None
-        if st.sorted and not self._dev_dict_sorted:
-            self._ensure_sorted_lanes()  # remap-only: the sort already ran
-        return st.lanes
+        if self._dev_dict_sorted:
+            # coherent and FINAL: either the state was born sorted or this
+            # copy already remapped; settled lanes never change again
+            return st.lanes
+        with st.lock:
+            # under the lock no sibling can be mid-settle: either the
+            # state is still the unsorted concat (coherent with our
+            # codes) or it settled completely and we remap before
+            # exposing the sorted lanes
+            if st.sorted:
+                self._settle_locked(st)  # remap-only: the sort already ran
+            return st.lanes
 
     @property
     def dictionary(self) -> np.ndarray:
@@ -205,6 +230,14 @@ class StringColumn:
         st = self._lane_state
         if st is None or self._dev_dict_sorted:
             return
+        with st.lock:
+            self._settle_locked(st)
+
+    def _settle_locked(self, st: "_LaneState") -> None:
+        """Settle the shared state (once) and remap this copy's codes.
+        Caller must hold ``st.lock``."""
+        if self._dev_dict_sorted:  # a sibling settled us meanwhile
+            return
         from ..utils.observe import telemetry
 
         if not st.sorted:
@@ -214,8 +247,11 @@ class StringColumn:
                 "lane-dict:deferred-sort", int(st.lanes[0].shape[0])
             ):
                 union, (trans,) = union_device([st.lanes])
-                st.lanes = union
+                # st.sorted is the publication flag: assign it LAST so a
+                # racing reader can never see sorted lanes before the
+                # translation table exists
                 st.trans = trans
+                st.lanes = union
                 st.sorted = True
         trans = st.trans
         sh = getattr(self.codes, "sharding", None)
@@ -224,15 +260,19 @@ class StringColumn:
             # the codes' mesh so the remap gather is placement-legal
             trans = jax.device_put(
                 trans,
-                jax.sharding.NamedSharding(sh.mesh, jax.sharding.PartitionSpec()),
+                jax.sharding.NamedSharding(
+                    sh.mesh, jax.sharding.PartitionSpec()
+                ),
             )
-        self.codes = jnp.where(
-            self.codes >= 0,
-            jnp.take(trans, jnp.clip(self.codes, 0), axis=0),
-            self.codes,
+        codes = self._codes_state[0]
+        remapped = jnp.where(
+            codes >= 0,
+            jnp.take(trans, jnp.clip(codes, 0), axis=0),
+            codes,
         )
         self._codes_host = None  # host mirror (if any) is stale
-        self._dev_dict_sorted = True
+        # one atomic publication: remapped codes + settled flag together
+        self._codes_state = (remapped, True)
 
     @property
     def dict_size(self) -> int:
@@ -323,16 +363,25 @@ class StringColumn:
     def __len__(self) -> int:
         return int(self.codes.shape[0])
 
-    def with_codes(self, codes) -> "StringColumn":
+    def with_codes(self, codes, dev_dict_sorted: "bool | None" = None) -> "StringColumn":
         """A column over *codes* carrying this column's dictionary and
         caches — the single definition of what survives a row gather:
         the decoded-dictionary cache always, and has_absent only when
         this column is known fully present (a subset of a fully-present
-        column is fully present)."""
+        column is fully present).
+
+        *dev_dict_sorted* must be the flag snapshotted TOGETHER with the
+        codes array the caller derived *codes* from (``_codes_state``);
+        omitting it reads the current flag, which is only safe when no
+        concurrent settle is possible (executor ops on already-settled
+        columns — sorts/joins require code order, so their inputs have
+        settled before they run)."""
         out = StringColumn(
             self._dictionary,
             codes,
-            dev_dict_sorted=self._dev_dict_sorted,
+            dev_dict_sorted=(
+                self._dev_dict_sorted if dev_dict_sorted is None else dev_dict_sorted
+            ),
             _lane_state=self._lane_state,
         )
         out._str_dict = self._str_dict
@@ -346,9 +395,12 @@ class StringColumn:
         *codes* substitutes a differently-placed copy of this column's
         codes (e.g. replicated onto the probe's mesh) — the dictionary
         and caches still come from self."""
-        src = self.codes if codes is None else codes
+        if codes is None:
+            src, flag = self._codes_state  # one atomic coherent pair
+        else:
+            src, flag = codes, self._dev_dict_sorted
         idx = jnp.asarray(sel, dtype=jnp.int32)
-        return self.with_codes(jnp.take(src, idx, axis=0))
+        return self.with_codes(jnp.take(src, idx, axis=0), dev_dict_sorted=flag)
 
     def decode_codes(self, codes: np.ndarray) -> List[Optional[str]]:
         """Decode a host code slice against this column's dictionary;
@@ -430,7 +482,9 @@ class StringColumn:
         q_lanes, q_pos = self._lanes_narrow()
         b_lanes, b_pos = other._lanes_narrow()
         if b_lanes[0].shape[0] == 0 or q_lanes[0].shape[0] == 0:
-            return jnp.full_like(self.codes, ABSENT)
+            # preserve negative code identity (-2 sharding pads stay -2),
+            # matching the main path below
+            return jnp.where(self.codes >= 0, ABSENT, self.codes)
         trans = translate_lanes(b_lanes, q_lanes)
         if b_pos is not None:
             # subset slots of other -> other's full code space
@@ -447,10 +501,12 @@ class StringColumn:
                 .at[jnp.asarray(q_pos)]
                 .set(trans)
             )
+        # negative codes pass through unchanged (-1 absent stays -1,
+        # -2 sharding pads stay -2), same as the empty-lane early return
         return jnp.where(
             self.codes >= 0,
             jnp.take(trans, jnp.clip(self.codes, 0), axis=0),
-            ABSENT,
+            self.codes,
         )
 
     def renumbered_to(self, other_dictionary: np.ndarray) -> jax.Array:
@@ -470,11 +526,13 @@ class StringColumn:
         )
         trans = np.where(ok, pos, -1).astype(np.int32)
         trans_dev = jax.device_put(trans, None)
-        # absent stays absent; unmatched becomes -1
+        # unmatched becomes -1; negative codes pass through unchanged
+        # (-1 absent stays -1, -2 sharding pads stay -2) so both
+        # translation paths keep the same negative-code identity
         return jnp.where(
             self.codes >= 0,
             jnp.take(jnp.asarray(trans_dev), jnp.clip(self.codes, 0), axis=0),
-            ABSENT,
+            self.codes,
         )
 
 
@@ -612,7 +670,8 @@ class DeviceTable:
         pad = (-self.nrows) % n_dev  # NamedSharding needs divisibility
         cols = {}
         for name, col in self.columns.items():
-            codes = np.asarray(col.codes)
+            src_codes, dict_sorted = col._codes_state  # atomic coherent pair
+            codes = np.asarray(src_codes)
             if pad:
                 # -2 = padding (never matches; distinct from -1 = absent);
                 # padding rows live beyond nrows, outside every selection
@@ -622,7 +681,7 @@ class DeviceTable:
             moved = StringColumn(
                 col._dictionary,
                 jax.device_put(codes, sharding),
-                dev_dict_sorted=col._dev_dict_sorted,
+                dev_dict_sorted=dict_sorted,
                 _lane_state=col._lane_state,
             )
             moved._str_dict = col._str_dict

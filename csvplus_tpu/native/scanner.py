@@ -238,7 +238,11 @@ def scan_bytes(
         n,
         delimiter.encode("utf-8"),
         (comment or "\x00").encode("utf-8")[0:1],
-        1 if comment else 0,
+        # multi-byte comments are ignored CONSISTENTLY across both native
+        # paths: the simple tokenizer can't honor them, so the full
+        # machine must not honor a truncated first byte either (library
+        # callers gate multi-byte comments upstream)
+        1 if comment and len(comment.encode("utf-8")) == 1 else 0,
         1 if lazy_quotes else 0,
         0,  # trim handled by the Python fallback (unicode semantics)
         starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
