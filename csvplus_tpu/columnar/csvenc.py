@@ -75,12 +75,21 @@ def encode_json_body(table: DeviceTable) -> Optional[str]:
 
     line = None
     for i, (name, col) in enumerate(zip(names, cols)):
-        d = col.dictionary_str()
-        enc = np.asarray(
-            [go_json_string(v) for v in d.tolist()],
-            dtype=np.str_,
-        )
-        vals = enc[np.asarray(col.codes)]
+        if getattr(col, "kind", "str") == "int":
+            # typed: '"<escaped prefix><digits>"' per row — digits and
+            # '-' never need JSON escaping, the constant prefix escapes
+            # once (go_json_string returns the quoted form; reuse its
+            # body)
+            body = go_json_string(col.prefix.decode("utf-8"))[1:-1]
+            digits = np.asarray(col.values).astype(np.str_)
+            vals = np.char.add(np.char.add('"' + body, digits), '"')
+        else:
+            d = col.dictionary_str()
+            enc = np.asarray(
+                [go_json_string(v) for v in d.tolist()],
+                dtype=np.str_,
+            )
+            vals = enc[np.asarray(col.codes)]
         prefix = ("{" if i == 0 else ",") + go_json_string(name) + ":"
         piece = np.char.add(prefix, vals)
         line = piece if line is None else np.char.add(line, piece)
@@ -115,8 +124,13 @@ def encode_csv_body(table: DeviceTable, columns: Sequence[str]) -> Optional[str]
 
     pieces = []
     for i, col in enumerate(cols):
-        d = _escape_dictionary(col.dictionary_str())
-        vals = d[np.asarray(col.codes)]
+        if getattr(col, "kind", "str") == "int":
+            vals = col.formatted_str()
+            if _affix_needs_quotes(col.prefix.decode("utf-8")):
+                vals = _escape_dictionary(vals)
+        else:
+            d = _escape_dictionary(col.dictionary_str())
+            vals = d[np.asarray(col.codes)]
         pieces.append(vals)
         if i < len(cols) - 1:
             pieces[-1] = np.char.add(vals, ",")
@@ -125,6 +139,16 @@ def encode_csv_body(table: DeviceTable, columns: Sequence[str]) -> Optional[str]
         line = np.char.add(line, p)
     line = np.char.add(line, "\n")
     return "".join(line.tolist())
+
+
+def _affix_needs_quotes(prefix: str) -> bool:
+    """Whether a typed column's values can need CSV quoting: only via
+    the constant prefix (digits and '-' never do, a typed value is never
+    empty or ``\\.``, and its first rune is the prefix's first rune or a
+    digit/'-')."""
+    return any(ch in prefix for ch in ',"\r\n') or (
+        prefix[:1].isspace() if prefix else False
+    )
 
 
 def _encode_csv_body_native(nrows: int, cols) -> Optional[str]:
@@ -141,6 +165,20 @@ def _encode_csv_body_native(nrows: int, cols) -> Optional[str]:
     per_col = []
     field_lens = []
     for col in cols:
+        if getattr(col, "kind", "str") == "int":
+            # typed: the formatted rows ARE the blob (identity codes);
+            # quoting can only come from the constant prefix
+            enc_s = col.formatted_host()
+            if _affix_needs_quotes(col.prefix.decode("utf-8")):
+                esc = _escape_dictionary(np.char.decode(enc_s, "utf-8"))
+                enc_s = np.char.encode(esc, "utf-8")
+            lens = np.char.str_len(enc_s).astype(np.int32)
+            blob = enc_s.tobytes()
+            offs = np.arange(lens.size, dtype=np.int64) * enc_s.dtype.itemsize
+            codes = np.arange(lens.size, dtype=np.int32)
+            per_col.append((blob, offs, lens, codes))
+            field_lens.append(lens.astype(np.int64))
+            continue
         d = _escape_dictionary(col.dictionary_str())
         enc = np.char.encode(d, "utf-8") if d.size else np.empty(0, "S1")
         lens = np.char.str_len(enc).astype(np.int32)

@@ -377,13 +377,13 @@ def _apply_map(view: _View, expr) -> None:
     if isinstance(expr, SetValue):
         n = _full_len(view)
         ref = next(iter(view.cols.values()), None)
-        if ref is not None and getattr(ref.codes, "sharding", None) is not None:
+        if ref is not None and getattr(ref.storage, "sharding", None) is not None:
             # match the existing columns' (possibly mesh-sharded) layout,
             # or mixing the constant into jitted ops crashes on devices
             import jax as _jax
 
             codes = _jax.device_put(
-                np.zeros(n, dtype=np.int32), ref.codes.sharding
+                np.zeros(n, dtype=np.int32), ref.storage.sharding
             )
             view.cols[expr.column] = StringColumn(
                 np.asarray([expr.value.encode("utf-8")], dtype="S"), codes

@@ -31,6 +31,14 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+
+def _encoded_nrows(value) -> int:
+    """Row count of one encoded column: (dictionary, codes) pairs count
+    codes; ("int", prefix, values) typed tuples count values."""
+    if len(value) == 3 and value[0] == "int":
+        return int(value[2].shape[0])
+    return int(value[1].shape[0])
+
 def source_from_table(table: DeviceTable) -> DataSource:
     """Plan-capable DataSource over an existing DeviceTable."""
     from .exec import plan_runner
@@ -67,9 +75,17 @@ def reader_to_device(
         except ImportError:
             StreamFallback = None
         if StreamFallback is not None:
+            if mesh is None and shards:
+                # resolve the mesh BEFORE ingest so chunks land directly
+                # on their shard (VERDICT r4 next #3) instead of staging
+                # the full table on one device and resharding
+                from ..parallel.mesh import make_mesh
+
+                mesh = make_mesh(shards)
+                shards = None
             try:
                 with telemetry.stage("ingest:streamed", 0) as _t:
-                    table = _stream_to_table(reader, path, device)
+                    table = _stream_to_table(reader, path, device, mesh=mesh)
                     table.row_base = row_base
                     _t["rows_out"] = table.nrows
                 return source_from_table(_maybe_shard(table, shards, mesh))
@@ -83,7 +99,7 @@ def reader_to_device(
                 enc = _sc.read_device_parsed_columns(reader, path)
                 if enc is not None:
                     names, data = enc
-                    nrows = data[names[0]][1].shape[0] if names else 0
+                    nrows = _encoded_nrows(data[names[0]]) if names else 0
                     table = DeviceTable.from_encoded(
                         {n: data[n] for n in names}, nrows, device=device
                     )
@@ -103,7 +119,7 @@ def reader_to_device(
                 enc = scanner.read_encoded_columns_native(reader, path)
                 if enc is not None:
                     names, data = enc
-                    nrows = data[names[0]][1].shape[0] if names else 0
+                    nrows = _encoded_nrows(data[names[0]]) if names else 0
                     table = DeviceTable.from_encoded(
                         {n: data[n] for n in names}, nrows, device=device
                     )
@@ -141,7 +157,7 @@ def _stream_ingest_wanted(path: str) -> bool:
         return False
 
 
-def _stream_to_table(reader, path: str, device) -> DeviceTable:
+def _stream_to_table(reader, path: str, device, mesh=None) -> DeviceTable:
     """Consume the native streaming chunk generator into one DeviceTable.
 
     Per chunk, each column's int32 codes are uploaded immediately (the
@@ -171,6 +187,26 @@ def _stream_to_table(reader, path: str, device) -> DeviceTable:
     costs a 100M-entry device sort at ingest (round-4 northstar
     profile) — strictly better than the reference, which materializes
     every row (csvplus.go:722-733).
+
+    TYPED VALUE LANES (VERDICT r4 next #2): chunks the generator parses
+    as ``("int", prefix, values)`` accumulate as narrowed int uploads
+    and finalize as one :class:`~csvplus_tpu.columnar.typed.IntColumn` —
+    no dictionary at any point.  A column whose later chunk stops
+    conforming demotes: the accumulated value chunks re-encode through
+    the exact dictionary path below (format + per-chunk unique), so the
+    result is bitwise identical to a never-typed run.
+
+    SHARDED INGEST (VERDICT r4 next #3, SURVEY §2 "host ingest
+    parallelism"): with *mesh* set, each chunk's arrays upload straight
+    to the mesh device that will own those rows (byte-position
+    round-assignment, monotone so per-device row ranges stay
+    contiguous); finalize stitches the per-device segments into ONE
+    row-sharded global array via boundary-sliver moves — no full-table
+    single-device buffer ever exists, and per-device memory is bounded
+    by ~n/k plus a chunk.  Columns that would switch to device-LANE
+    dictionaries raise :class:`StreamFallback` under a mesh (the
+    whole-file tiers + ``with_sharding`` handle that shape); typed and
+    host-dictionary columns — every north-star column — shard natively.
     """
     import jax
     import jax.numpy as jnp
@@ -181,7 +217,20 @@ def _stream_to_table(reader, path: str, device) -> DeviceTable:
     from .table import StringColumn, default_device
 
     dev = default_device(device)
-    encoder = _device_chunk_encoder(dev) if _device_parse_enabled() else None
+    shard_devs = None
+    _fsize = _cb = 1
+    if mesh is not None:
+        from ..native.scanner import _stream_chunk_bytes
+
+        shard_devs = list(mesh.devices.flat)
+        _fsize = max(os.path.getsize(path), 1)
+        _cb = _stream_chunk_bytes()
+    # under a mesh, codes must be born on their shard: host encode only
+    encoder = (
+        _device_chunk_encoder(dev)
+        if (_device_parse_enabled() and shard_devs is None)
+        else None
+    )
     prefetch_depth = _env_int("CSVPLUS_STREAM_PREFETCH", 1)
     lane_thresh = _env_int("CSVPLUS_DICT_DEVICE_MIN_DISTINCT", 4_000_000)
     names = None
@@ -200,13 +249,105 @@ def _stream_to_table(reader, path: str, device) -> DeviceTable:
         lanes = lanes_for_width(max_width[c])
         return tuple(jax.device_put(l, dev) for l in pack_host(d, lanes))
 
+    int_vals: "dict[str, list]" = {}  # typed mode: device value chunks
+    int_prefix: "dict[str, bytes]" = {}
+
+    def add_dict_chunk(c, d, codes, tgt=None):
+        """One chunk's (dictionary, codes) through the dictionary-path
+        bookkeeping (host union / device-lane switching / narrowed code
+        upload) — shared by the normal path and typed-chunk demotion.
+        *tgt* is the device this chunk's codes live on (the chunk's
+        shard under a mesh, the single ingest device otherwise)."""
+        max_width[c] = max(max_width[c], d.dtype.itemsize)
+        if max_width[c] > 32:  # past the lane cap (ops/lanes.py)
+            host_only[c] = True
+            if chunk_lanes[c]:
+                # already committed to lanes and a later chunk brings
+                # a wider value: this tier cannot finish the column —
+                # the whole-file tiers handle the file instead
+                from ..native.scanner import StreamFallback
+
+                raise StreamFallback(
+                    f'column "{c}" exceeded the lane width cap mid-stream'
+                )
+        if not host_only[c] and not chunk_lanes[c]:
+            ru = running_union[c]
+            if ru is None:
+                running_union[c] = d
+            else:
+                dt = np.dtype(f"S{max_width[c]}")
+                running_union[c] = np.union1d(ru.astype(dt), d.astype(dt))
+        if isinstance(codes, np.ndarray):
+            # narrow the upload to the smallest dtype the chunk's
+            # dictionary needs (codes are nonnegative slot numbers):
+            # a low-cardinality column ships 1-2 bytes/row instead
+            # of 4, and the remap gather restores int32 on device
+            if d.size <= 0xFF:
+                codes = codes.astype(np.uint8)
+            elif d.size <= 0xFFFF:
+                codes = codes.astype(np.uint16)
+        chunk_codes[c].append(jax.device_put(codes, tgt if tgt is not None else dev))
+        if chunk_lanes[c] or (
+            not host_only[c]
+            and running_union[c] is not None
+            and running_union[c].size >= lane_thresh
+        ):
+            if shard_devs is not None:
+                # the deferred-lane representation cannot be built
+                # shard-resident chunk by chunk; the whole-file tiers +
+                # with_sharding handle this (rare now that typed lanes
+                # absorb high-cardinality numeric ids)
+                from ..native.scanner import StreamFallback
+
+                raise StreamFallback(
+                    f'column "{c}" crossed the lane threshold under sharded ingest'
+                )
+            # lane mode (newly or already): host dictionaries
+            # convert to device lanes and are freed — the RSS bound
+            running_union[c] = None
+            if chunk_dicts[c]:
+                chunk_lanes[c] = [_to_lanes(p) for p in chunk_dicts[c]]
+                chunk_dicts[c] = []
+            chunk_lanes[c].append(_to_lanes(d))
+        else:
+            chunk_dicts[c].append(d)
+
+    def demote_typed(c):
+        """Re-encode a no-longer-typed column's accumulated value chunks
+        through the dictionary path — bitwise identical to a never-typed
+        run (format_affix is the exact inverse of the native parse).
+        Each re-encoded chunk stays on the device its values live on."""
+        from .typed import format_affix
+
+        for dev_arr in int_vals[c]:
+            v = np.asarray(dev_arr).astype(np.int32)
+            strs = format_affix(int_prefix[c], v)
+            dd, cc = np.unique(strs, return_inverse=True)
+            add_dict_chunk(
+                c,
+                dd,
+                cc.astype(np.int32),
+                tgt=dev_arr.device if shard_devs is not None else None,
+            )
+        int_vals[c] = []
+
     chunks = stream_encoded_chunks(reader, path, encoder=encoder)
     if prefetch_depth > 0:
         # overlap chunk N+1's read+scan+encode (producer thread) with
         # chunk N's upload + dictionary-union bookkeeping (this thread);
         # host RSS bound becomes (depth + 2) chunks instead of 1
         chunks = _prefetch_iter(chunks, prefetch_depth)
+    ci = -1
+    tgt = dev
     for cnames, encoded, n in chunks:
+        ci += 1
+        if shard_devs is not None:
+            # byte-position assignment: chunk i covers roughly bytes
+            # [i*cb, (i+1)*cb), so its rows belong to the device owning
+            # that fraction of the file.  Monotone in i, so each shard's
+            # rows form one contiguous global range.
+            k = len(shard_devs)
+            tgt = shard_devs[min(k - 1, ci * _cb * k // _fsize)]
         if names is None:
             names = cnames
             chunk_dicts = {c: [] for c in names}
@@ -215,59 +356,49 @@ def _stream_to_table(reader, path: str, device) -> DeviceTable:
             running_union = {c: None for c in names}
             max_width = {c: 1 for c in names}
             host_only = {c: False for c in names}
+            int_vals = {c: [] for c in names}
         nrows += n
         for c in names:
-            d, codes = encoded[c]
-            max_width[c] = max(max_width[c], d.dtype.itemsize)
-            if max_width[c] > 32:  # past the lane cap (ops/lanes.py)
-                host_only[c] = True
-                if chunk_lanes[c]:
-                    # already committed to lanes and a later chunk brings
-                    # a wider value: this tier cannot finish the column —
-                    # the whole-file tiers handle the file instead
-                    from ..native.scanner import StreamFallback
-
-                    raise StreamFallback(
-                        f'column "{c}" exceeded the lane width cap mid-stream'
-                    )
-            if not host_only[c] and not chunk_lanes[c]:
-                ru = running_union[c]
-                if ru is None:
-                    running_union[c] = d
-                else:
-                    dt = np.dtype(f"S{max_width[c]}")
-                    running_union[c] = np.union1d(ru.astype(dt), d.astype(dt))
-            if isinstance(codes, np.ndarray):
-                # narrow the upload to the smallest dtype the chunk's
-                # dictionary needs (codes are nonnegative slot numbers):
-                # a low-cardinality column ships 1-2 bytes/row instead
-                # of 4, and the remap gather restores int32 on device
-                if d.size <= 0xFF:
-                    codes = codes.astype(np.uint8)
-                elif d.size <= 0xFFFF:
-                    codes = codes.astype(np.uint16)
-            chunk_codes[c].append(jax.device_put(codes, dev))
-            if chunk_lanes[c] or (
-                not host_only[c]
-                and running_union[c] is not None
-                and running_union[c].size >= lane_thresh
-            ):
-                # lane mode (newly or already): host dictionaries
-                # convert to device lanes and are freed — the RSS bound
-                running_union[c] = None
-                if chunk_dicts[c]:
-                    chunk_lanes[c] = [_to_lanes(p) for p in chunk_dicts[c]]
-                    chunk_dicts[c] = []
-                chunk_lanes[c].append(_to_lanes(d))
-            else:
-                chunk_dicts[c].append(d)
+            enc = encoded[c]
+            if len(enc) == 3 and enc[0] == "int":
+                _, prefix, vals = enc
+                int_prefix[c] = prefix
+                # narrow the upload to the smallest dtype holding the
+                # chunk's value range; device concat restores int32
+                lo, hi = (int(vals.min()), int(vals.max())) if vals.size else (0, 0)
+                if -128 <= lo and hi <= 127:
+                    vals = vals.astype(np.int8)
+                elif -32768 <= lo and hi <= 32767:
+                    vals = vals.astype(np.int16)
+                int_vals[c].append(jax.device_put(vals, tgt))
+                continue
+            if int_vals.get(c):
+                demote_typed(c)  # column left typed mode this chunk
+            add_dict_chunk(c, *enc, tgt=tgt)
     if names is None:  # empty file: defer to the whole-file tiers
         from ..native.scanner import StreamFallback
 
         raise StreamFallback("empty file")
 
+    if shard_devs is not None:
+        return _finalize_sharded(
+            mesh,
+            shard_devs,
+            names,
+            nrows,
+            int_vals,
+            int_prefix,
+            chunk_dicts,
+            chunk_codes,
+        )
+
     out = {}
     for c in names:
+        if int_vals.get(c):
+            from .typed import IntColumn
+
+            out[c] = IntColumn(int_prefix[c], _values_concat(tuple(int_vals[c])))
+            continue
         dicts, codes = chunk_dicts[c], chunk_codes[c]
         if chunk_lanes[c]:
             lanes_list = chunk_lanes[c]
@@ -403,6 +534,160 @@ def _offset_concat(codes, offsets):
     return _offset_kernel(codes, offsets)
 
 
+def _assemble_rows_sharded(mesh, shard_devs, arrs, nrows, pad_value):
+    """Stitch per-chunk int32 device arrays (chunk order == global row
+    order, each committed to its shard) into ONE row-sharded global
+    array over *mesh*.
+
+    Chunks were assigned to devices monotonically, so each device holds
+    one contiguous global row range; the NamedSharding block structure
+    wants row range [d*b, (d+1)*b) on flat device d (b = ceil(n/k)), so
+    only boundary SLIVERS move between neighboring devices — per-device
+    memory stays ~n/k and no full-table single-device buffer ever
+    exists.  The tail pads with *pad_value* (outside every selection)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from ..parallel.mesh import row_spec
+
+    k = len(shard_devs)
+    b = -(-nrows // k)  # ceil: NamedSharding block size
+    # consecutive same-device chunk runs -> (global_start, seg_array)
+    segs = []  # (gstart, arr) with arr committed to one device
+    run, run_dev, run_start, gpos = [], None, 0, 0
+    for arr in arrs:
+        d = arr.device
+        if run and d != run_dev:
+            segs.append((run_start, run[0] if len(run) == 1 else jnp.concatenate(run)))
+            run, run_start = [], gpos
+        run_dev = d
+        run.append(arr)
+        gpos += int(arr.shape[0])
+    if run:
+        segs.append((run_start, run[0] if len(run) == 1 else jnp.concatenate(run)))
+
+    bufs = []
+    for d in range(k):
+        # a tiny table can leave trailing devices fully past nrows:
+        # their block is then all padding (t1 clamps up to t0)
+        t0 = d * b
+        t1 = max(t0, min((d + 1) * b, nrows))
+        pieces = []
+        for gs, arr in segs:
+            ge = gs + int(arr.shape[0])
+            lo, hi = max(gs, t0), min(ge, t1)
+            if lo >= hi:
+                continue
+            sl = arr[lo - gs : hi - gs]
+            if sl.device != shard_devs[d]:
+                sl = jax.device_put(sl, shard_devs[d])
+            pieces.append(sl)
+        pad = b - (t1 - t0)
+        if pad > 0:
+            pieces.append(
+                jax.device_put(
+                    np.full(pad, pad_value, dtype=np.int32), shard_devs[d]
+                )
+            )
+        buf = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+        bufs.append(buf)
+    return jax.make_array_from_single_device_arrays(
+        (b * k,), NamedSharding(mesh, row_spec(mesh)), bufs
+    )
+
+
+def _finalize_sharded(
+    mesh,
+    shard_devs,
+    names,
+    nrows,
+    int_vals,
+    int_prefix,
+    chunk_dicts,
+    chunk_codes,
+):
+    """Sharded-ingest finalize: every column becomes a globally
+    row-sharded array assembled from its shard-resident chunks (typed
+    value lanes or dictionary codes; lane-dictionary columns were
+    excluded by StreamFallback upstream)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..utils.observe import telemetry
+    from .table import DeviceTable, StringColumn
+    from .typed import IntColumn
+
+    out = {}
+    with telemetry.stage("ingest:shard-assemble", nrows) as _t:
+        _t["n_shards"] = len(shard_devs)
+        _t["max_shard_rows"] = -(-nrows // len(shard_devs))
+        for c in names:
+            if int_vals.get(c):
+                arrs = [
+                    a if a.dtype == jnp.int32 else a.astype(jnp.int32)
+                    for a in int_vals[c]
+                ]
+                out[c] = IntColumn(
+                    int_prefix[c],
+                    _assemble_rows_sharded(mesh, shard_devs, arrs, nrows, 0),
+                )
+                continue
+            dicts, codes = chunk_dicts[c], chunk_codes[c]
+            if len(dicts) == 1:
+                arrs = [
+                    a if a.dtype == jnp.int32 else a.astype(jnp.int32)
+                    for a in codes
+                ]
+                out[c] = StringColumn(
+                    dicts[0],
+                    _assemble_rows_sharded(mesh, shard_devs, arrs, nrows, -2),
+                )
+                continue
+            width = max(d.dtype.itemsize for d in dicts)
+            dt = np.dtype(f"S{width}")
+            union = np.unique(np.concatenate([d.astype(dt) for d in dicts]))
+            # remap each chunk ON ITS SHARD (the mapping table is tiny)
+            arrs = [
+                jnp.take(
+                    jax.device_put(
+                        np.searchsorted(union, d.astype(dt)).astype(np.int32),
+                        ck.device,
+                    ),
+                    ck.astype(jnp.int32),
+                    axis=0,
+                )
+                for d, ck in zip(dicts, codes)
+            ]
+            out[c] = StringColumn(
+                union, _assemble_rows_sharded(mesh, shard_devs, arrs, nrows, -2)
+            )
+    table = DeviceTable(out, nrows, shard_devs[0])
+    table._pre_sharded = True
+    return table
+
+
+_values_kernel = None
+
+
+def _values_concat(chunks):
+    """Concatenate per-chunk (narrow-uploaded) value arrays into one
+    int32 device array — one jitted call for the whole typed column."""
+    global _values_kernel
+    if _values_kernel is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(cks):
+            return jnp.concatenate([c.astype(jnp.int32) for c in cks])
+
+        _values_kernel = kernel
+    return _values_kernel(chunks)
+
+
 _remap_kernel = None
 
 
@@ -489,6 +774,8 @@ def _device_parse_enabled() -> bool:
 
 
 def _maybe_shard(table: DeviceTable, shards, mesh) -> DeviceTable:
+    if getattr(table, "_pre_sharded", False):
+        return table  # chunks already landed on their shards at ingest
     if mesh is None and shards:
         from ..parallel.mesh import make_mesh
 

@@ -175,9 +175,23 @@ class StringColumn:
             dev_dict_sorted if self._lane_state is not None else True,
         )
 
+    kind = "str"
+
     @property
     def codes(self) -> jax.Array:
         return self._codes_state[0]
+
+    @property
+    def storage(self) -> jax.Array:
+        """The kind-agnostic row-indexed device array (shared protocol
+        with :class:`~csvplus_tpu.columnar.typed.IntColumn`): dictionary
+        codes here, int32 value lanes there.  Row-materializing consumers
+        (gathers, sorts' payload permutation, sync) use this so a typed
+        payload column is never demoted just to ride along."""
+        return self.codes
+
+    def with_storage(self, arr) -> "StringColumn":
+        return self.with_codes(arr)
 
     @property
     def _dev_dict_sorted(self) -> bool:
@@ -620,11 +634,23 @@ class DeviceTable:
         ((dictionary, codes) pairs, e.g. the native ingest fast path;
         a ready StringColumn — e.g. a device-lane-dictionary column from
         the streamed ingest — passes through unchanged)."""
+        from .typed import IntColumn
+
         dev = default_device(device)
         cols = {}
         for name, value in data.items():
-            if isinstance(value, StringColumn):
+            if isinstance(value, (StringColumn, IntColumn)):
                 cols[name] = value
+                continue
+            if len(value) == 3 and value[0] == "int":
+                # typed value lanes from the native/streamed scanners
+                _, prefix, vals = value
+                cols[name] = IntColumn(
+                    prefix,
+                    vals
+                    if isinstance(vals, jax.Array)
+                    else jax.device_put(vals, dev),
+                )
                 continue
             dictionary, codes = value
             cols[name] = StringColumn(
@@ -669,7 +695,18 @@ class DeviceTable:
         n_dev = mesh.devices.size
         pad = (-self.nrows) % n_dev  # NamedSharding needs divisibility
         cols = {}
+        from .typed import IntColumn
+
         for name, col in self.columns.items():
+            if isinstance(col, IntColumn):
+                vals = np.asarray(col.values)
+                if pad:
+                    # typed pad value is 0: pad rows live beyond nrows,
+                    # outside every selection, and typed columns carry
+                    # no absent/pad sentinel semantics
+                    vals = np.concatenate([vals, np.zeros(pad, np.int32)])
+                cols[name] = IntColumn(col.prefix, jax.device_put(vals, sharding))
+                continue
             src_codes, dict_sorted = col._codes_state  # atomic coherent pair
             codes = np.asarray(src_codes)
             if pad:
@@ -703,7 +740,7 @@ class DeviceTable:
         """
         if self.already_forced:
             return self
-        cols = [c.codes for c in self.columns.values()]
+        cols = [c.storage for c in self.columns.values()]
         cols = [c for c in cols if c.shape[0]]
         if not cols:
             return self
@@ -750,7 +787,10 @@ class DeviceTable:
         is pure numpy — no device dispatch at all."""
         out = [Row() for _ in range(upper - lower)]
         for name, col in self.columns.items():
-            vals = col.decode_codes(col.codes_host()[lower:upper])
+            if col.kind == "int":
+                vals = col.decode_slice(lower, upper)  # host format, no demote
+            else:
+                vals = col.decode_codes(col.codes_host()[lower:upper])
             for i, v in enumerate(vals):
                 if v is not None:
                     out[i][name] = v

@@ -192,16 +192,17 @@ class ThreewayJoin:
                 self.qk_cust,
                 self.qk_prod,
                 tuple(
-                    # a mesh-sharded stream gathers from build codes
-                    # replicated onto its mesh (broadcast-join layout)
+                    # a mesh-sharded stream gathers from build storage
+                    # (codes OR typed value lanes) replicated onto its
+                    # mesh (broadcast-join layout)
                     _aligned_codes(
-                        self.cust, n, self.cust.table.columns[n].codes, self.qk_cust
+                        self.cust, n, self.cust.table.columns[n].storage, self.qk_cust
                     )
                     for n in names_c
                 ),
                 tuple(
                     _aligned_codes(
-                        self.prod, n, self.prod.table.columns[n].codes, self.qk_prod
+                        self.prod, n, self.prod.table.columns[n].storage, self.qk_prod
                     )
                     for n in names_p
                 ),
@@ -230,12 +231,12 @@ class ThreewayJoin:
             if not direct:
                 ones = jnp.ones(self.n_orders, dtype=bool)
                 g_c = gather_columns(
-                    lo_c, ones, *(self.cust.table.columns[n].codes for n in names_c)
+                    lo_c, ones, *(self.cust.table.columns[n].storage for n in names_c)
                 )
                 g_p = gather_columns(
-                    lo_p, ones, *(self.prod.table.columns[n].codes for n in names_p)
+                    lo_p, ones, *(self.prod.table.columns[n].storage for n in names_p)
                 )
-            g_o = tuple(self.orders_cols[n].codes for n in names_o)
+            g_o = tuple(self.orders_cols[n].storage for n in names_o)
             n_out = self.n_orders
         else:
             # compaction path (unmatched rows or padded/sharded stream):
@@ -251,27 +252,27 @@ class ThreewayJoin:
             ids_c = jax.device_put(ids_c, dev_c)
             ids_p = jax.device_put(ids_p, dev_p)
             g_c = tuple(
-                jnp.take(self.cust.table.columns[n].codes, ids_c, axis=0)
+                jnp.take(self.cust.table.columns[n].storage, ids_c, axis=0)
                 for n in names_c
             )
             g_p = tuple(
-                jnp.take(self.prod.table.columns[n].codes, ids_p, axis=0)
+                jnp.take(self.prod.table.columns[n].storage, ids_p, axis=0)
                 for n in names_p
             )
             g_o = tuple(
-                jnp.take(self.orders_cols[n].codes, sel, axis=0)
+                jnp.take(self.orders_cols[n].storage, sel, axis=0)
                 for n in names_o
             )
             n_out = int(sel.shape[0])
 
         out: Dict[str, StringColumn] = {}
         for name, codes in zip(names_c, g_c):
-            out[name] = self.cust.table.columns[name].with_codes(codes)
+            out[name] = self.cust.table.columns[name].with_storage(codes)
         for name, codes in zip(names_p, g_p):
-            out[name] = self.prod.table.columns[name].with_codes(codes)
+            out[name] = self.prod.table.columns[name].with_storage(codes)
         for name, codes in zip(names_o, g_o):  # stream wins
-            out[name] = self.orders_cols[name].with_codes(codes)
-        device = next(iter(out.values())).codes.device if out else None
+            out[name] = self.orders_cols[name].with_storage(codes)
+        device = next(iter(out.values())).storage.device if out else None
         table = DeviceTable(out, n_out, device)
         if direct and unpadded and n_valid == self.n_orders:
             # the int(n_dev) sync above blocked on the fused executable,
@@ -279,7 +280,7 @@ class ThreewayJoin:
             # through stream columns are settled once (first run) below
             if not self._orders_settled:
                 for col in self.orders_cols.values():
-                    col.codes.block_until_ready()
+                    col.storage.block_until_ready()
                 self._orders_settled = True
             table.already_forced = True
         return table

@@ -257,6 +257,101 @@ void csv_pack_fields_u64(const char* buf, const int64_t* starts,
   }
 }
 
+// Typed value lanes: parse n (start, len) fields as `prefix + canonical
+// int32 suffix` — the affix form covering pure integers (empty prefix,
+// sign allowed) and prefixed ids ("o123", "c45").  Canonical means the
+// suffix round-trips bitwise through int->decimal formatting: "0" or
+// [1-9][0-9]*, value <= INT32_MAX (negatives only with an empty prefix,
+// no "-0", value >= -INT32_MAX so |v| always formats).  On the first
+// call *prefix_len is -1 and the prefix derives from field 0 (longest
+// canonical suffix; leading zeros join the prefix); later calls verify
+// the caller's prefix.  Returns 1 when every field conforms (out[] is
+// filled), 0 otherwise — a failed chunk costs one pass and the column
+// falls back to dictionary encoding.
+static inline int parse_canon_i32(const char* p, int32_t l, int allow_sign,
+                                  int32_t* out) {
+  if (l <= 0) return 0;
+  int neg = 0;
+  if (allow_sign && p[0] == '-') {
+    neg = 1;
+    p++;
+    l--;
+    if (l <= 0 || p[0] == '0') return 0;  // "-" / "-0" / "-0..." invalid
+  }
+  if (l > 10) return 0;
+  if (l > 1 && p[0] == '0') return 0;  // leading zero
+  int64_t v = 0;
+  for (int32_t i = 0; i < l; ++i) {
+    const char c = p[i];
+    if (c < '0' || c > '9') return 0;
+    v = v * 10 + (c - '0');
+  }
+  if (v > 2147483647) return 0;  // also rejects INT32_MIN via |v| bound
+  *out = neg ? (int32_t)-v : (int32_t)v;
+  return 1;
+}
+
+int64_t csv_pack_int32(const char* buf, const int64_t* starts,
+                       const int32_t* lens, int64_t n, char* prefix_buf,
+                       int64_t* prefix_len, int64_t prefix_cap,
+                       int32_t* out) {
+  if (n == 0) return 1;
+  if (*prefix_len < 0) {
+    // derive from field 0: whole-cell signed canonical -> empty prefix;
+    // else prefix = cell minus its longest canonical unsigned suffix
+    const char* f0 = buf + starts[0];
+    const int32_t l0 = lens[0];
+    if (parse_canon_i32(f0, l0, 1, out)) {
+      *prefix_len = 0;
+    } else {
+      int32_t d0 = l0;  // start of the trailing digit run
+      while (d0 > 0 && f0[d0 - 1] >= '0' && f0[d0 - 1] <= '9') d0--;
+      int32_t s = d0;
+      // shrink until the suffix is canonical AND fits int32
+      while (s < l0 && !parse_canon_i32(f0 + s, l0 - s, 0, out)) s++;
+      if (s >= l0) return 0;  // no usable numeric suffix
+      if (s > prefix_cap) return 0;
+      memcpy(prefix_buf, f0, (size_t)s);
+      *prefix_len = s;
+    }
+  }
+  const int64_t plen = *prefix_len;
+  const int allow_sign = plen == 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const char* f = buf + starts[i];
+    const int32_t l = lens[i];
+    if (l < plen || (plen && memcmp(f, prefix_buf, (size_t)plen) != 0))
+      return 0;
+    if (!parse_canon_i32(f + plen, l - (int32_t)plen, allow_sign, &out[i]))
+      return 0;
+  }
+  return 1;
+}
+
+// Format n int32 values as decimal into a fixed-width (n, width) byte
+// matrix, NUL-padded — the typed column's demote/materialize pre-pass
+// (the inverse of csv_pack_int32's parse).  Caller guarantees width >=
+// 11 (sign + 10 digits).  lens_out gets each value's decimal length.
+void csv_format_i32(const int32_t* values, int64_t n, int32_t width,
+                    char* out, int32_t* lens_out) {
+  for (int64_t i = 0; i < n; ++i) {
+    char tmp[12];
+    int32_t v = values[i];
+    int p = 12;
+    uint32_t a = v < 0 ? (uint32_t)(-(int64_t)v) : (uint32_t)v;
+    do {
+      tmp[--p] = (char)('0' + a % 10);
+      a /= 10;
+    } while (a);
+    if (v < 0) tmp[--p] = '-';
+    const int32_t l = 12 - p;
+    char* dst = out + i * (int64_t)width;
+    memcpy(dst, tmp + p, (size_t)l);
+    memset(dst + l, 0, (size_t)(width - l));
+    lens_out[i] = l;
+  }
+}
+
 // CSV body assembly: scatter one column's escaped dictionary entries
 // into a pre-sized row-major output buffer, appending `sep` after each
 // field (',' mid-row, '\n' for the last column).  The caller computes

@@ -160,6 +160,25 @@ def _load():
             ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.csv_pack_int32.restype = ctypes.c_int64
+        lib.csv_pack_int32.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.c_void_p,
+        ]
+        lib.csv_format_i32.restype = None
+        lib.csv_format_i32.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
         _lib = lib
         return lib
 
@@ -396,6 +415,107 @@ def _pack_fields_native(
     else:
         run(0, n)
     return out
+
+
+_PREFIX_CAP = 24  # affix prefixes longer than this fall back to dictionary
+
+
+def pack_int32_native(
+    combined: np.ndarray,
+    starts: np.ndarray,
+    lens: np.ndarray,
+    prefix: "bytes | None",
+):
+    """Parse a column's fields as ``prefix + canonical int32`` (typed
+    value lanes).  Returns ``(prefix, int32 values)`` when every field
+    conforms, else None.  ``prefix=None`` derives the prefix from the
+    first field (first chunk); later chunks pass the established prefix
+    so a drifting column is rejected.  GIL released in the C++ parse,
+    threaded over row ranges like the field pack."""
+    try:
+        lib = _load()
+    except ImportError:
+        return None
+    n = int(starts.shape[0])
+    if n == 0:
+        return None  # nothing to derive a prefix from; let dictionary run
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    out = np.empty(n, dtype=np.int32)
+    base = combined.ctypes.data
+    pbuf = ctypes.create_string_buffer(_PREFIX_CAP)
+    if prefix is None:
+        plen = ctypes.c_int64(-1)  # derive from field 0
+    else:
+        if len(prefix) > _PREFIX_CAP:
+            return None
+        pbuf.raw = prefix + b"\x00" * (_PREFIX_CAP - len(prefix))
+        plen = ctypes.c_int64(len(prefix))
+
+    def run(lo: int, hi: int) -> int:
+        return int(
+            lib.csv_pack_int32(
+                base,
+                starts[lo:hi].ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                lens[lo:hi].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                hi - lo,
+                pbuf,
+                ctypes.byref(plen),
+                _PREFIX_CAP,
+                out[lo:hi].ctypes.data,
+            )
+        )
+
+    if plen.value < 0:
+        # derive the prefix from field 0 alone so the threaded ranges
+        # below all verify against one established prefix
+        if not run(0, 1):
+            return None
+    k = min(os.cpu_count() or 1, 8)
+    if n >= _PACK_THREADS_MIN_N and k >= 2:
+        bounds = [n * i // k for i in range(k + 1)]
+        oks = list(
+            _pack_pool_get().map(lambda b: run(*b), zip(bounds[:-1], bounds[1:]))
+        )
+        if not all(oks):
+            return None
+    else:
+        if not run(0, n):
+            return None
+    return bytes(pbuf.raw[: plen.value]), out
+
+
+def format_i32_native(values: np.ndarray, width: int = 12):
+    """(NUL-padded (n, width) u8 matrix, int32 lens) of the decimal
+    forms of *values* — the typed column's C++ materialize pre-pass; None
+    when the native library is unavailable."""
+    try:
+        lib = _load()
+    except ImportError:
+        return None
+    values = np.ascontiguousarray(values, dtype=np.int32)
+    n = int(values.shape[0])
+    out = np.empty((n, width), dtype=np.uint8)
+    lens = np.empty(n, dtype=np.int32)
+    if n == 0:
+        return out, lens
+
+    def run(lo: int, hi: int) -> None:
+        lib.csv_format_i32(
+            values[lo:hi].ctypes.data,
+            hi - lo,
+            width,
+            out[lo:hi].ctypes.data,
+            lens[lo:hi].ctypes.data,
+        )
+
+    k = min(os.cpu_count() or 1, 8)
+    if n >= _PACK_THREADS_MIN_N and k >= 2:
+        bounds = [n * i // k for i in range(k + 1)]
+        list(_pack_pool_get().map(lambda b: run(*b), zip(bounds[:-1], bounds[1:])))
+    else:
+        run(0, n)
+    return out, lens
 
 
 def encode_fields_vectorized(
@@ -700,16 +820,24 @@ def read_encoded_columns_native(reader, path: str):
         _column_positions(data_counts, field_offset, header, rec_base, pad_allowed)
     )
 
+    typed_enabled = os.environ.get("CSVPLUS_TYPED_LANES", "1") != "0"
+
     def enc_one(args):
         name, pos, ok = args
-        if ok.all():
+        all_present = bool(ok.all())
+        if all_present:
             col_starts, col_lens = abs_starts[pos], lens[pos]
         else:
             col_starts = np.where(ok, abs_starts[np.where(ok, pos, 0)], 0)
             col_lens = np.where(ok, lens[np.where(ok, pos, 0)], 0)
-        enc = encode_fields_vectorized(
-            combined, col_starts, col_lens.astype(np.int32)
-        )
+        col_lens = col_lens.astype(np.int32)
+        if typed_enabled and all_present:
+            # typed value lanes (SURVEY §7 M2), same form as the
+            # streamed tier: prefix + canonical int32 per cell
+            packed = pack_int32_native(combined, col_starts, col_lens, None)
+            if packed is not None:
+                return name, ("int", packed[0], packed[1])
+        enc = encode_fields_vectorized(combined, col_starts, col_lens)
         if enc is None:
             raise _EncodeFallback(name)
         return name, enc
@@ -815,6 +943,15 @@ def stream_encoded_chunks(
     ``encoder(combined_u8, data_bytes, col_starts, col_lens)`` returns
     ``(dictionary, codes)`` or None to decline (then the host vectorized
     encode runs) — the hook the device-encode ingest tier plugs in.
+
+    TYPED VALUE LANES (SURVEY §7 M2 "typed columns where parseable"): a
+    column whose every cell so far is ``prefix + canonical int32``
+    (native ``csv_pack_int32``) yields ``("int", prefix, int32 values)``
+    instead of a dictionary pair — no dictionary encode at all, 4
+    bytes/row.  The prefix is derived from the very first cell and
+    pinned; the first non-conforming chunk switches the column to
+    dictionary encoding permanently (the consumer re-encodes the
+    accumulated chunks).  Disable with ``CSVPLUS_TYPED_LANES=0``.
     """
     if reader._trim_leading_space:
         raise StreamFallback("trim")
@@ -828,6 +965,10 @@ def stream_encoded_chunks(
     expected = reader._num_fields  # locked after the first record, Go csv.Reader style
     pad_allowed = reader._num_fields < 0
     next_record = 1  # absolute 1-based ordinal of the next record scanned
+    typed_enabled = os.environ.get("CSVPLUS_TYPED_LANES", "1") != "0"
+    # per-column typed state: [prefix bytes | None] while eligible
+    # (None = derive from the first cell), absent key = dictionary mode
+    typed_state: "Dict[str, list]" = {}
 
     with open(path, "rb") as f:
         pending = b""
@@ -904,6 +1045,8 @@ def stream_encoded_chunks(
                 )
                 names = list(header)
                 first_data_record = rec_base
+                if typed_enabled:
+                    typed_state = {n: [None] for n in names}
             else:
                 field_offset = 0
                 data_counts = counts
@@ -933,13 +1076,29 @@ def stream_encoded_chunks(
 
             def enc_one(args):
                 name, pos, ok = args
-                if ok.all():
+                all_present = bool(ok.all())
+                if all_present:
                     col_starts, col_lens = abs_starts[pos], lens[pos].astype(np.int32)
                 else:
                     col_starts = np.where(ok, abs_starts[np.where(ok, pos, 0)], 0)
                     col_lens = np.where(ok, lens[np.where(ok, pos, 0)], 0).astype(
                         np.int32
                     )
+                st = typed_state.get(name)
+                if st is not None:
+                    # typed value-lane attempt; a padded/absent cell or a
+                    # non-conforming field drops the column to dictionary
+                    # mode for good (one flag write; chunks are
+                    # sequential and each column has one task per chunk)
+                    packed = (
+                        pack_int32_native(combined, col_starts, col_lens, st[0])
+                        if all_present
+                        else None
+                    )
+                    if packed is not None:
+                        st[0] = packed[0]
+                        return name, ("int", packed[0], packed[1])
+                    typed_state.pop(name, None)
                 enc = (
                     encoder(combined, enc_data, col_starts, col_lens)
                     if encoder is not None

@@ -67,8 +67,20 @@ def _mask_from_terms(terms, nrows: int, mode: str):
     return mask
 
 
+def _column_term(c, val):
+    """(storage array, target) equality term for one column, or None
+    when no cell can equal *val*.  Typed columns compare value lanes
+    against the parsed constant — no demotion; dictionary columns
+    compare codes against the dictionary slot."""
+    if c.kind == "int":
+        v = c.equality_term(val)
+        return None if v is None else (c.values, v)
+    code = c.find_code(val)
+    return None if code < 0 else (c.codes, code)
+
+
 def _equality_terms(cols, preds):
-    """Flatten predicates into (codes, target) equality terms when every
+    """Flatten predicates into (array, target) equality terms when every
     one is a single-column Like; terms on missing columns/values drop out
     (they are constant-false in a disjunction).  None = not flattenable."""
     terms = []
@@ -78,11 +90,10 @@ def _equality_terms(cols, preds):
         (col, val), = p.match.items()
         if col not in cols:
             continue
-        c = cols[col]
-        code = c.find_code(val)
-        if code < 0:
+        term = _column_term(cols[col], val)
+        if term is None:
             continue
-        terms.append((c.codes, code))
+        terms.append(term)
     return terms
 
 
@@ -93,11 +104,10 @@ def build_mask(cols: Dict[str, StringColumn], nrows: int, pred) -> jnp.ndarray:
         for col, val in pred.match.items():
             if col not in cols:
                 return jnp.zeros(nrows, dtype=bool)
-            c = cols[col]
-            code = c.find_code(val)
-            if code < 0:
+            term = _column_term(cols[col], val)
+            if term is None:
                 return jnp.zeros(nrows, dtype=bool)
-            terms.append((c.codes, code))
+            terms.append(term)
         assert terms  # Like() rejects empty match rows
         return _mask_from_terms(terms, nrows, mode="all")
     if isinstance(pred, All):

@@ -667,11 +667,14 @@ def join_tables(
 
     build_names = list(dev_index.table.columns)
     stream_names = list(stream.columns)
+    # kind-agnostic storage arrays: dictionary codes or typed value
+    # lanes — the row-materializing gathers below treat them alike, so
+    # a typed payload column is never demoted by the join
     build_codes = tuple(
-        _aligned_codes(dev_index, n, dev_index.table.columns[n].codes, build_ids)
+        _aligned_codes(dev_index, n, dev_index.table.columns[n].storage, build_ids)
         for n in build_names
     )
-    stream_codes = tuple(stream.columns[n].codes for n in stream_names)
+    stream_codes = tuple(stream.columns[n].storage for n in stream_names)
 
     if probe_ids is None:
         # all-matched unique fast path: stream columns pass through
@@ -707,12 +710,12 @@ def join_tables(
     out_cols = {}
     for name, codes in zip(build_names, g_build):
         src = dev_index.table.columns[name]
-        out_cols[name] = src.with_codes(codes)
+        out_cols[name] = src.with_storage(codes)
     for name, codes in zip(stream_names, g_stream):  # stream wins on collision...
         g = (
             stream.columns[name]
             if probe_ids is None
-            else stream.columns[name].with_codes(codes)
+            else stream.columns[name].with_storage(codes)
         )
         if name in out_cols:
             # ...but an absent stream cell keeps the index value

@@ -111,6 +111,37 @@ def fnv1a_lanes_device(lane_arrays):
     return h
 
 
+def fnv1a_affix_int_device(prefix: bytes, values) -> "object":
+    """32-bit FNV-1a per ROW of a typed affix-int32 column, computed ON
+    DEVICE from the value lanes — byte-identical to :func:`fnv1a_values`
+    over ``prefix + decimal(value)``, with no formatting and no
+    dictionary (typed columns have neither).  The constant prefix folds
+    into the seed on host; the per-row part hashes an optional '-' and
+    the up-to-10 decimal digits MSB-first via pow10 gathers."""
+    import jax.numpy as jnp
+
+    h0 = int(_FNV_OFFSET)
+    for b in prefix:
+        h0 = ((h0 ^ b) * int(_FNV_PRIME)) & 0xFFFFFFFF
+    v = jnp.asarray(values)
+    neg = v < 0
+    av = jnp.where(neg, -v, v)  # |v| <= 2^31-1 (parser rejects INT32_MIN)
+    h = jnp.full(v.shape, jnp.uint32(h0))
+    h = jnp.where(neg, (h ^ jnp.uint32(ord("-"))) * jnp.uint32(_FNV_PRIME), h)
+    pow10 = jnp.asarray([10**k for k in range(10)], dtype=jnp.int32)
+    nd = jnp.ones(v.shape, jnp.int32)
+    for k in range(1, 10):
+        nd = nd + (av >= pow10[k]).astype(jnp.int32)
+    for i in range(10):
+        e = jnp.clip(nd - 1 - i, 0, 9)
+        p = jnp.take(pow10, e, axis=0)
+        digit = (av // p) % 10
+        byte = (jnp.uint32(ord("0")) + digit.astype(jnp.uint32))
+        active = i < nd
+        h = jnp.where(active, (h ^ byte) * jnp.uint32(_FNV_PRIME), h)
+    return h
+
+
 def checksum_device_table(
     table,
     columns: Optional[Sequence[str]] = None,
@@ -131,18 +162,41 @@ def checksum_device_table(
     weights = (
         2 * jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(1) if positional else None
     )
+    # mesh-sharded tables: each column's reduction lowers to a cross-
+    # device all-reduce; concurrent eagerly-dispatched collective
+    # programs can race the XLA:CPU rendezvous (observed: 7-of-8
+    # participants, hard abort), so their scalars sync one at a time
+    serialize = any(
+        len(getattr(table.columns[c].storage, "sharding", None).device_set) > 1
+        if getattr(table.columns[c].storage, "sharding", None) is not None
+        else False
+        for c in names
+    )
     sums = []
     for c in names:
         col = table.columns[c]
-        if getattr(col, "dev_dictionary", None) is not None and col._dictionary is None:
-            htab = fnv1a_lanes_device(col.dev_dictionary)
+        if getattr(col, "kind", "str") == "int":
+            # typed value lanes hash per row directly (no dictionary,
+            # no demotion); all cells present by the typed invariant
+            gathered = fnv1a_affix_int_device(col.prefix, col.values[:n])
         else:
-            htab = jax.device_put(fnv1a_values(col.dictionary).astype(jnp.uint32))
-        codes = col.codes[:n]
-        gathered = jnp.take(htab, jnp.clip(codes, 0), axis=0)
-        gathered = jnp.where(codes >= 0, gathered, jnp.uint32(0))
+            if (
+                getattr(col, "dev_dictionary", None) is not None
+                and col._dictionary is None
+            ):
+                htab = fnv1a_lanes_device(col.dev_dictionary)
+            else:
+                htab = jax.device_put(
+                    fnv1a_values(col.dictionary).astype(jnp.uint32)
+                )
+            codes = col.codes[:n]
+            gathered = jnp.take(htab, jnp.clip(codes, 0), axis=0)
+            gathered = jnp.where(codes >= 0, gathered, jnp.uint32(0))
         if weights is not None:
             gathered = gathered * weights
-        sums.append(jnp.sum(gathered, dtype=jnp.uint32))
+        s = jnp.sum(gathered, dtype=jnp.uint32)
+        sums.append(np.uint32(s) if serialize else s)
+    if serialize:
+        return {c: int(v) for c, v in zip(names, sums)}
     stacked = np.asarray(jnp.stack(sums)) if sums else np.empty(0, np.uint32)
     return {c: int(v) for c, v in zip(names, stacked)}

@@ -29,6 +29,9 @@ class StageRecord:
     rows_in: int
     rows_out: int
     seconds: float
+    # any other keys the stage body set (e.g. the sharded-ingest
+    # assembly's n_shards / max_shard_rows placement evidence)
+    extra: dict = field(default_factory=dict)
 
     def __str__(self) -> str:
         return (
@@ -88,6 +91,11 @@ class Telemetry:
                 rows_in=rows_in,
                 rows_out=int(out.get("rows_out", rows_in)),
                 seconds=time.perf_counter() - t0,
+                extra={
+                    k: v
+                    for k, v in out.items()
+                    if k not in ("rows_out", "discard")
+                },
             )
         )
 

@@ -1,0 +1,191 @@
+"""Mesh-scale north-star run: the 3-way join end-to-end SHARDED.
+
+VERDICT r4 next #4: the at-scale record must exist for the MESH path,
+not just single-device — sharded streamed ingest (chunks land on their
+shard, ingest.py `_finalize_sharded`) → broadcast joins over the
+row-sharded stream → per-column checksum parity vs the host executor,
+with per-stage wall times and placement evidence in the JSON.
+
+Runs on the virtual 8-device CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``) — re-execs
+itself into that environment if the current process lacks 8 devices.
+
+Usage: python examples/northstar_mesh.py [n_orders]   (default 10M)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_SHARDS = 8
+
+
+def _ensure_mesh_env() -> None:
+    """Re-exec into a hermetic 8-device CPU environment when needed."""
+    if os.environ.get("NORTHSTAR_MESH_HERMETIC") == "1":
+        return
+    env = dict(os.environ)
+    env["NORTHSTAR_MESH_HERMETIC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={N_SHARDS}"
+        ).strip()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def main() -> None:
+    _ensure_mesh_env()
+    # the sharded-ingest path lives in the streamed tier; engage it at
+    # any file size for this run (recorded in the JSON)
+    os.environ.setdefault("CSVPLUS_STREAM_MIN_BYTES", "1")
+
+    n_orders = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    from northstar import DATA_DIR, generate  # same generator/cache
+
+    opath = generate(n_orders)
+    print(
+        f"orders file: {opath} ({os.path.getsize(opath) / 1e9:.2f} GB)",
+        file=sys.stderr,
+    )
+
+    import jax
+
+    from csvplus_tpu import FromFile, Take
+    from csvplus_tpu.utils.observe import telemetry
+
+    assert len(jax.devices()) >= N_SHARDS, jax.devices()
+
+    t0 = time.perf_counter()
+    with telemetry.collect() as records:
+        orders = FromFile(opath).OnDevice(shards=N_SHARDS)
+        orders.plan.table.sync()
+    t_ingest = time.perf_counter() - t0
+    table = orders.plan.table
+    assemble = next(
+        (r for r in records if r.stage == "ingest:shard-assemble"), None
+    )
+    pre_sharded = bool(getattr(table, "_pre_sharded", False))
+    shard_counts = {
+        name: len(col.storage.sharding.device_set)
+        for name, col in table.columns.items()
+    }
+    print(
+        f"ingest (sharded): {n_orders / t_ingest:,.0f} rows/s ({t_ingest:,.1f}s),"
+        f" pre_sharded={pre_sharded}, per-column shard counts={shard_counts},"
+        f" rss {_rss_mb():,.0f} MB",
+        file=sys.stderr,
+    )
+    assert pre_sharded, "sharded ingest did not engage"
+    assert all(v == N_SHARDS for v in shard_counts.values()), shard_counts
+
+    t0 = time.perf_counter()
+    cust_idx = (
+        FromFile(os.path.join(DATA_DIR, "customers.csv")).OnDevice().UniqueIndexOn("id")
+    )
+    prod_idx = (
+        FromFile(os.path.join(DATA_DIR, "products.csv"))
+        .OnDevice()
+        .UniqueIndexOn("prod_id")
+    )
+    t_index = time.perf_counter() - t0
+    print(f"index build: {t_index:,.1f}s", file=sys.stderr)
+
+    joined = orders.Join(cust_idx, "cust_id").Join(prod_idx)
+    t0 = time.perf_counter()
+    result = joined.to_device_table().sync()
+    t_join = time.perf_counter() - t0
+    assert result.nrows == n_orders, result.nrows
+    print(
+        f"3-way join (sharded stream, broadcast build): "
+        f"{n_orders / t_join:,.0f} rows/s ({t_join:,.2f}s)",
+        file=sys.stderr,
+    )
+    t0 = time.perf_counter()
+    joined.to_device_table().sync()
+    t_warm = time.perf_counter() - t0
+    print(
+        f"3-way join (warm): {n_orders / t_warm:,.0f} rows/s ({t_warm:,.2f}s)",
+        file=sys.stderr,
+    )
+
+    # ---- verification: positional checksums vs the host executor on a
+    # 1M-row prefix + full-result checksums for cross-run comparison ----
+    from csvplus_tpu import StopPipeline, take_rows
+    from csvplus_tpu.utils.checksum import (
+        checksum_device_table,
+        checksum_host_rows,
+    )
+
+    sample = min(1_000_000, n_orders)
+    head: list = []
+
+    def collect(row):
+        head.append(row)
+        if len(head) >= sample:
+            raise StopPipeline
+
+    Take(FromFile(opath))(collect)
+    h_cust = Take(FromFile(os.path.join(DATA_DIR, "customers.csv"))).UniqueIndexOn("id")
+    h_prod = Take(FromFile(os.path.join(DATA_DIR, "products.csv"))).UniqueIndexOn(
+        "prod_id"
+    )
+    t0 = time.perf_counter()
+    host_rows = take_rows(head).Join(h_cust, "cust_id").Join(h_prod).to_rows()
+    cols = sorted(result.columns)
+    want = checksum_host_rows(host_rows, cols, positional=True)
+    got = checksum_device_table(result, cols, limit=sample, positional=True)
+    assert got == want, f"checksum mismatch over the first {sample} rows"
+    t_verify = time.perf_counter() - t0
+    print(
+        f"parity: positional checksums over the first {sample:,} rows match"
+        f" the host executor ({t_verify:,.1f}s)",
+        file=sys.stderr,
+    )
+    full_sums = checksum_device_table(result, cols, positional=True)
+
+    print(
+        json.dumps(
+            {
+                "metric": "northstar_mesh_threeway_join",
+                "rows": n_orders,
+                "n_shards": N_SHARDS,
+                "backend": jax.default_backend(),
+                "ingest_rows_per_sec": round(n_orders / t_ingest, 1),
+                "join_rows_per_sec": round(n_orders / t_join, 1),
+                "join_rows_per_sec_warm": round(n_orders / t_warm, 1),
+                "end_to_end_sec": round(t_ingest + t_index + t_join, 1),
+                "peak_host_rss_mb": round(_rss_mb(), 1),
+                "pre_sharded_ingest": pre_sharded,
+                "max_shard_rows": assemble.extra.get("max_shard_rows")
+                if assemble
+                else None,
+                "column_shard_counts": shard_counts,
+                "parity_checked_rows": sample,
+                "full_result_checksums": full_sums,
+                "note": (
+                    "virtual 8-device CPU mesh: rates measure the sharded "
+                    "EXECUTION PATH (placement, collectives, assembly), not "
+                    "chip throughput; chunks land on their shard at ingest "
+                    "(no full-table single-device buffer) and the joins run "
+                    "broadcast over the row-sharded stream"
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

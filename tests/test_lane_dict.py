@@ -130,6 +130,9 @@ def test_threshold_splits_columns_by_cardinality(tmp_path, monkeypatch):
     monkeypatch.setenv("CSVPLUS_STREAM_MIN_BYTES", "1")
     monkeypatch.setenv("CSVPLUS_STREAM_CHUNK_BYTES", "512")
     monkeypatch.setenv("CSVPLUS_DICT_DEVICE_MIN_DISTINCT", "100")
+    # this test pins the DICTIONARY cardinality-split behavior; typed
+    # value lanes would otherwise claim the numeric-suffix columns
+    monkeypatch.setenv("CSVPLUS_TYPED_LANES", "0")
     p = tmp_path / "o.csv"
     p.write_text(
         "order_id,cust,qty\n"
@@ -290,7 +293,16 @@ def test_deferred_lanes_survive_mesh_sharding(tmp_path, monkeypatch):
         "order_id,cust,qty\n"
         + "".join(f"ord-{i:06d},c{i % 7},{i % 5}\n" for i in range(640))
     )
-    dev = from_file(str(p)).on_device(shards=len(jax.devices()))
+    # sharded ingest (shards=) intentionally excludes lane columns, so
+    # the lane-through-with_sharding path is driven explicitly: stream
+    # unsharded (deferred lanes form), then reshard the table
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.parallel.mesh import make_mesh
+
+    pre = from_file(str(p)).on_device()
+    col = pre.plan.table.columns["order_id"]
+    assert col._lane_state is not None and not col._dev_dict_sorted
+    dev = source_from_table(pre.plan.table.with_sharding(make_mesh()))
     col = dev.plan.table.columns["order_id"]
     assert col._lane_state is not None and not col._dev_dict_sorted
     # key on the deferred lane column over sharded codes

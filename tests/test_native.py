@@ -171,7 +171,15 @@ def _encoded_to_strings(enc):
     names, data = enc
     out = {}
     for name in names:
-        d, c = data[name]
+        enc_col = data[name]
+        if len(enc_col) == 3 and enc_col[0] == "int":
+            from csvplus_tpu.columnar.typed import format_affix
+
+            out[name] = np.char.decode(
+                format_affix(enc_col[1], enc_col[2]), "utf-8"
+            ).tolist()
+            continue
+        d, c = enc_col
         ds = np.char.decode(d, "utf-8") if d.dtype.kind == "S" else d
         out[name] = ds[c].tolist()
     return names, out

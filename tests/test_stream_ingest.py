@@ -35,8 +35,16 @@ def _collect(reader, path, chunk_bytes):
             cols = {c: [] for c in names}
         total += n
         for c in names:
-            d, codes = encoded[c]
-            vals = np.char.decode(d.astype("S256"), "utf-8")[codes]
+            enc = encoded[c]
+            if len(enc) == 3 and enc[0] == "int":
+                from csvplus_tpu.columnar.typed import format_affix
+
+                vals = np.char.decode(
+                    format_affix(enc[1], enc[2]).astype("S256"), "utf-8"
+                )
+            else:
+                d, codes = enc
+                vals = np.char.decode(d.astype("S256"), "utf-8")[codes]
             cols[c].extend(vals.tolist())
     return names, cols, total
 
