@@ -626,13 +626,17 @@ def _finalize_sharded(
         _t["max_shard_rows"] = -(-nrows // len(shard_devs))
         for c in names:
             if int_vals.get(c):
+                from .typed import PAD_VALUE
+
                 arrs = [
                     a if a.dtype == jnp.int32 else a.astype(jnp.int32)
                     for a in int_vals[c]
                 ]
                 out[c] = IntColumn(
                     int_prefix[c],
-                    _assemble_rows_sharded(mesh, shard_devs, arrs, nrows, 0),
+                    _assemble_rows_sharded(
+                        mesh, shard_devs, arrs, nrows, int(PAD_VALUE)
+                    ),
                 )
                 continue
             dicts, codes = chunk_dicts[c], chunk_codes[c]

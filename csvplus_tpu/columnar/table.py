@@ -699,12 +699,16 @@ class DeviceTable:
 
         for name, col in self.columns.items():
             if isinstance(col, IntColumn):
+                from .typed import PAD_VALUE
+
                 vals = np.asarray(col.values)
                 if pad:
-                    # typed pad value is 0: pad rows live beyond nrows,
-                    # outside every selection, and typed columns carry
-                    # no absent/pad sentinel semantics
-                    vals = np.concatenate([vals, np.zeros(pad, np.int32)])
+                    # PAD_VALUE can never be a real cell (the parser
+                    # bounds |v| <= INT32_MAX), so pad rows stay
+                    # unambiguous through translations and demotion
+                    vals = np.concatenate(
+                        [vals, np.full(pad, PAD_VALUE, np.int32)]
+                    )
                 cols[name] = IntColumn(col.prefix, jax.device_put(vals, sharding))
                 continue
             src_codes, dict_sorted = col._codes_state  # atomic coherent pair

@@ -49,6 +49,14 @@ import jax
 import jax.numpy as jnp
 
 
+# Sharding-pad sentinel for typed value lanes: INT32_MIN can never be a
+# real cell (csv_pack_int32 bounds |v| <= INT32_MAX), so pad rows are
+# unambiguous — they translate to -2 (the StringColumn pad identity),
+# never enter a demoted dictionary, and can't alias a real "prefix+0"
+# key the way a 0-pad would (review r5 finding).
+PAD_VALUE = np.int32(np.iinfo(np.int32).min)
+
+
 class IntColumn:
     """One affix-int32 typed column (see module docstring)."""
 
@@ -172,15 +180,35 @@ class IntColumn:
             with telemetry.stage("typed:demote", int(self.values.shape[0])):
                 u = jnp.unique(self.values)  # device sort+dedup
                 uu = np.asarray(u)
+                # sharding pads (PAD_VALUE sorts first) never enter the
+                # dictionary; their rows code as -2 below
+                has_pad = bool(uu.size) and uu[0] == PAD_VALUE
+                if has_pad:
+                    uu = uu[1:]
+                    u = u[1:]
                 strs = self._format_host(uu)
                 order = np.argsort(strs, kind="stable")  # numeric -> lex
                 dictionary = strs[order]
-                code_of = np.empty(uu.shape[0], dtype=np.int32)
-                code_of[order] = np.arange(uu.shape[0], dtype=np.int32)
-                # numeric rank of each row, then numeric-slot -> lex code
-                pos = jnp.searchsorted(u, self.values)
-                codes = jnp.take(jax.device_put(code_of), pos, axis=0)
-                self._demoted = StringColumn(dictionary, codes, _has_absent=False)
+                if uu.size == 0:  # empty (or all-pad) column
+                    codes = jnp.full(
+                        self.values.shape, -2 if has_pad else -1, jnp.int32
+                    )
+                else:
+                    code_of = np.empty(uu.shape[0], dtype=np.int32)
+                    code_of[order] = np.arange(uu.shape[0], dtype=np.int32)
+                    # numeric rank per row, then numeric-slot -> lex code
+                    pos = jnp.searchsorted(u, self.values)
+                    pos = jnp.minimum(pos, int(uu.shape[0]) - 1)
+                    codes = jnp.take(jax.device_put(code_of), pos, axis=0)
+                    if has_pad:
+                        codes = jnp.where(
+                            self.values == jnp.int32(PAD_VALUE),
+                            jnp.int32(-2),
+                            codes,
+                        )
+                self._demoted = StringColumn(
+                    dictionary, codes, _has_absent=False if not has_pad else None
+                )
         return self._demoted
 
     @property
@@ -241,20 +269,33 @@ class IntColumn:
 
     def _translate_by_values(self, state) -> jax.Array:
         """Rows translated through a :meth:`_build_translation` state;
-        miss -> -1."""
+        miss -> -1, sharding pads -> -2 (the same negative-code identity
+        the StringColumn translation preserves)."""
+        is_pad = self.values == jnp.int32(PAD_VALUE)
         if state[0] == "dense":
             _, lo, table = state
-            idx = self.values - jnp.int32(lo)
-            ok = (idx >= 0) & (idx < table.shape[0])
+            # pads masked BEFORE the subtraction: PAD_VALUE - lo wraps
+            # int32 and could land inside the dense range
+            safe = jnp.where(is_pad, jnp.int32(lo), self.values)
+            idx = safe - jnp.int32(lo)
+            ok = (idx >= 0) & (idx < table.shape[0]) & ~is_pad
             got = jnp.take(table, jnp.clip(idx, 0, table.shape[0] - 1), axis=0)
-            return jnp.where(ok, got, jnp.int32(-1))
+            return jnp.where(ok, got, jnp.where(is_pad, jnp.int32(-2), jnp.int32(-1)))
         _, sorted_vals, code_of = state
         if int(sorted_vals.shape[0]) == 0:
-            return jnp.full(self.values.shape, -1, jnp.int32)
+            return jnp.where(
+                is_pad,
+                jnp.int32(-2),
+                jnp.full(self.values.shape, -1, jnp.int32),
+            )
         pos = jnp.searchsorted(sorted_vals, self.values)
         pos = jnp.minimum(pos, sorted_vals.shape[0] - 1)
-        hit = jnp.take(sorted_vals, pos, axis=0) == self.values
-        return jnp.where(hit, jnp.take(code_of, pos, axis=0), jnp.int32(-1))
+        hit = (jnp.take(sorted_vals, pos, axis=0) == self.values) & ~is_pad
+        return jnp.where(
+            hit,
+            jnp.take(code_of, pos, axis=0),
+            jnp.where(is_pad, jnp.int32(-2), jnp.int32(-1)),
+        )
 
     def renumbered_to(self, other_dictionary: np.ndarray) -> jax.Array:
         """Translate rows into *other_dictionary*'s code space without

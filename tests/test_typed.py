@@ -249,6 +249,47 @@ def test_typed_index_sort_find_dedup(joined_files):
     assert _dicts(Take(idx_h).to_rows()) == _dicts(Take(idx_d).to_rows())
 
 
+def test_typed_sharding_pads_never_alias_prefix_zero(tmp_path):
+    """Review r5 regression: a 0-valued pad would alias a real 'c0'/'p0'
+    build key and fabricate phantom rows through the flagship padded-
+    stream compaction.  Pads must translate to -2 like string pads."""
+    import jax
+
+    from csvplus_tpu.columnar.table import DeviceTable
+    from csvplus_tpu.models.flagship import ThreewayJoin
+    from csvplus_tpu.ops.join import DeviceIndex
+    from csvplus_tpu.ops.sort import sort_table
+    from csvplus_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    # 3 rows over the mesh: pads are unavoidable
+    path = _write(
+        tmp_path,
+        "order_id,cust_id,prod_id\no1,c1,p1\no2,c0,p0\no3,c2,p1\n",
+    )
+    orders = FromFile(path).on_device().plan.table
+    assert isinstance(orders.columns["cust_id"], IntColumn)
+    sharded = orders.with_sharding(make_mesh())
+    cust = DeviceTable.from_pylists(
+        {"id": ["c0", "c1", "c2"], "name": ["n0", "n1", "n2"]}
+    )
+    prod = DeviceTable.from_pylists({"prod_id": ["p0", "p1"], "product": ["a", "b"]})
+    tw = ThreewayJoin.build(
+        sharded,
+        DeviceIndex.build(sort_table(cust, ["id"]), ["id"]),
+        DeviceIndex.build(sort_table(prod, ["prod_id"]), ["prod_id"]),
+    )
+    out = tw.run()
+    assert out.nrows == 3, f"phantom pad rows joined: {out.to_rows()}"
+    got = sorted(r["order_id"] for r in out.to_rows())
+    assert got == ["o1", "o2", "o3"]
+    # demotion of a padded typed column must not invent a 'c<PAD>' entry
+    col = sharded.columns["cust_id"]
+    demoted = col._demote()
+    assert demoted.dictionary.tolist() == [b"c0", b"c1", b"c2"]
+
+
 def test_typed_sharded_roundtrip(joined_files):
     import jax
 
