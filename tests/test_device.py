@@ -69,6 +69,28 @@ def test_filter_missing_column_false(host_people, dev_people):
     same(dev_people.filter(n).to_rows(), host_people.filter(n).to_rows())
 
 
+def test_chained_filters_narrow_selection(host_people, dev_people):
+    """A second filter whose selection is far narrower than the stored
+    columns takes the gathered-sub-column path (exec._SelView); parity
+    and ordering must be identical, including when it empties out or
+    when a Top slice sits between the filters."""
+    for chain in (
+        lambda s: s.filter(Like({"name": "Amelia"})).filter(
+            Like({"surname": "Jones"})
+        ),
+        lambda s: s.filter(Like({"name": "Amelia"}))
+        .top(3)
+        .filter(Not(Like({"surname": "Smith"}))),
+        lambda s: s.filter(Like({"name": "Amelia"})).filter(
+            Like({"surname": "NOPE"})
+        ),
+        lambda s: s.filter(Like({"name": "Amelia"})).filter(
+            Like({"nope": "x"})
+        ),
+    ):
+        same(chain(dev_people).to_rows(), chain(host_people).to_rows())
+
+
 def test_select_drop_columns_parity(host_people, dev_people):
     same(
         dev_people.select_columns("id", "name").to_rows(),
