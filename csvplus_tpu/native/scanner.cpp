@@ -291,16 +291,19 @@ static inline int parse_canon_i32(const char* p, int32_t l, int allow_sign,
   return 1;
 }
 
-int64_t csv_pack_int32(const char* buf, const int64_t* starts,
-                       const int32_t* lens, int64_t n, char* prefix_buf,
-                       int64_t* prefix_len, int64_t prefix_cap,
-                       int32_t* out) {
+// ONE pack core shared by the contiguous and strided entry points
+// (field i of the parse is flat field off + i*stride).
+static int64_t pack_i32_core(const char* buf, const int64_t* starts,
+                             const int32_t* lens, int64_t n, int64_t stride,
+                             int64_t off, char* prefix_buf,
+                             int64_t* prefix_len, int64_t prefix_cap,
+                             int32_t* out) {
   if (n == 0) return 1;
   if (*prefix_len < 0) {
-    // derive from field 0: whole-cell signed canonical -> empty prefix;
-    // else prefix = cell minus its longest canonical unsigned suffix
-    const char* f0 = buf + starts[0];
-    const int32_t l0 = lens[0];
+    // derive from the first field: whole-cell signed canonical -> empty
+    // prefix; else prefix = cell minus its longest canonical suffix
+    const char* f0 = buf + starts[off];
+    const int32_t l0 = lens[off];
     if (parse_canon_i32(f0, l0, 1, out)) {
       *prefix_len = 0;
     } else {
@@ -318,14 +321,108 @@ int64_t csv_pack_int32(const char* buf, const int64_t* starts,
   const int64_t plen = *prefix_len;
   const int allow_sign = plen == 0;
   for (int64_t i = 0; i < n; ++i) {
-    const char* f = buf + starts[i];
-    const int32_t l = lens[i];
+    const int64_t fi = off + i * stride;
+    const char* f = buf + starts[fi];
+    const int32_t l = lens[fi];
     if (l < plen || (plen && memcmp(f, prefix_buf, (size_t)plen) != 0))
       return 0;
     if (!parse_canon_i32(f + plen, l - (int32_t)plen, allow_sign, &out[i]))
       return 0;
   }
   return 1;
+}
+
+int64_t csv_pack_int32(const char* buf, const int64_t* starts,
+                       const int32_t* lens, int64_t n, char* prefix_buf,
+                       int64_t* prefix_len, int64_t prefix_cap,
+                       int32_t* out) {
+  return pack_i32_core(buf, starts, lens, n, 1, 0, prefix_buf, prefix_len,
+                       prefix_cap, out);
+}
+
+// Strided variant for RECTANGULAR chunks: column `off` of record i sits
+// at flat field index off + i*stride, so the per-column position-array
+// gather (and its Python-side construction) disappears entirely — the
+// single-core ingest profile's second-largest cost after the scan.
+int64_t csv_pack_int32_strided(const char* buf, const int64_t* starts,
+                               const int32_t* lens, int64_t n_records,
+                               int64_t stride, int64_t off,
+                               char* prefix_buf, int64_t* prefix_len,
+                               int64_t prefix_cap, int32_t* out) {
+  return pack_i32_core(buf, starts, lens, n_records, stride, off,
+                       prefix_buf, prefix_len, prefix_cap, out);
+}
+
+// FUSED tokenize + typed parse for fully-typed rectangular chunks: one
+// pass over the bytes, emitting int32 affix values per selected column
+// and NOTHING else — no (start, len) offset arrays at all.  At 100M
+// rows the two-pass path writes ~4.8GB of field offsets that the typed
+// parse then re-reads; this replaces both with a single streaming pass.
+//
+// Contract (caller pre-checks): no quote/CR/comment bytes in the chunk,
+// every selected column already in typed mode with an ESTABLISHED
+// prefix, records end at '\n' (a final record may end at EOF), blank
+// lines skip at record start.  `outs[c]` is the output array for field
+// c, or NULL for unselected fields (skipped without typed constraints).
+// Returns the record count on success, 0 to bail (any non-conforming
+// cell, field-count mismatch, overflow past max_records) — the caller
+// then reruns the chunk through the generic scan, which also owns the
+// exact row-numbered error reporting.
+int64_t csv_scan_parse_i32(const char* buf, int64_t len, char delim,
+                           int64_t ncols, const char* prefix_blob,
+                           const int64_t* prefix_off,
+                           const int64_t* prefix_len, int32_t** outs,
+                           int64_t max_records) {
+  int64_t pos = 0;
+  int64_t nrec = 0;
+  while (pos < len) {
+    if (buf[pos] == '\n') { pos++; continue; }  // blank line at record start
+    if (nrec >= max_records) return 0;
+    for (int64_t c = 0; c < ncols; ++c) {
+      const char term = (c == ncols - 1) ? '\n' : delim;
+      if (outs[c] == nullptr) {
+        // unselected field: raw skip to terminator
+        while (pos < len && buf[pos] != delim && buf[pos] != '\n') pos++;
+      } else {
+        const int64_t plen = prefix_len[c];
+        const char* pfx = prefix_blob + prefix_off[c];
+        if (pos + plen > len || memcmp(buf + pos, pfx, (size_t)plen) != 0)
+          return 0;
+        pos += plen;
+        int neg = 0;
+        if (plen == 0 && pos < len && buf[pos] == '-') { neg = 1; pos++; }
+        if (pos >= len || buf[pos] < '0' || buf[pos] > '9') return 0;
+        if (buf[pos] == '0') {
+          // canonical: "0" must be the whole suffix
+          outs[c][nrec] = 0;
+          pos++;
+          if (neg) return 0;  // "-0" never stored
+          if (pos < len && buf[pos] >= '0' && buf[pos] <= '9') return 0;
+        } else {
+          int64_t v = 0;
+          int digits = 0;
+          while (pos < len && buf[pos] >= '0' && buf[pos] <= '9') {
+            v = v * 10 + (buf[pos] - '0');
+            if (++digits > 10) return 0;
+            pos++;
+          }
+          if (v > 2147483647) return 0;
+          outs[c][nrec] = neg ? (int32_t)-v : (int32_t)v;
+        }
+      }
+      // terminator
+      if (pos >= len) {
+        // EOF terminates the LAST field of a record only
+        if (c != ncols - 1) return 0;
+      } else if (buf[pos] == term) {
+        pos++;
+      } else {
+        return 0;  // wrong arity / stray byte
+      }
+    }
+    nrec++;
+  }
+  return nrec;
 }
 
 // Format n int32 values as decimal into a fixed-width (n, width) byte

@@ -171,6 +171,31 @@ def _load():
             ctypes.c_int64,
             ctypes.c_void_p,
         ]
+        lib.csv_pack_int32_strided.restype = ctypes.c_int64
+        lib.csv_pack_int32_strided.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.c_void_p,
+        ]
+        lib.csv_scan_parse_i32.restype = ctypes.c_int64
+        lib.csv_scan_parse_i32.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_char,
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_int64,
+        ]
         lib.csv_format_i32.restype = None
         lib.csv_format_i32.argtypes = [
             ctypes.c_void_p,
@@ -420,6 +445,18 @@ def _pack_fields_native(
 _PREFIX_CAP = 24  # affix prefixes longer than this fall back to dictionary
 
 
+def _prefix_marshal(prefix: "bytes | None"):
+    """(ctypes prefix buffer, c_int64 length) for the pack entry points;
+    None when the prefix exceeds the cap.  Length -1 = derive."""
+    pbuf = ctypes.create_string_buffer(_PREFIX_CAP)
+    if prefix is None:
+        return pbuf, ctypes.c_int64(-1)
+    if len(prefix) > _PREFIX_CAP:
+        return None
+    pbuf.raw = prefix + b"\x00" * (_PREFIX_CAP - len(prefix))
+    return pbuf, ctypes.c_int64(len(prefix))
+
+
 def pack_int32_native(
     combined: np.ndarray,
     starts: np.ndarray,
@@ -443,14 +480,10 @@ def pack_int32_native(
     lens = np.ascontiguousarray(lens, dtype=np.int32)
     out = np.empty(n, dtype=np.int32)
     base = combined.ctypes.data
-    pbuf = ctypes.create_string_buffer(_PREFIX_CAP)
-    if prefix is None:
-        plen = ctypes.c_int64(-1)  # derive from field 0
-    else:
-        if len(prefix) > _PREFIX_CAP:
-            return None
-        pbuf.raw = prefix + b"\x00" * (_PREFIX_CAP - len(prefix))
-        plen = ctypes.c_int64(len(prefix))
+    marshalled = _prefix_marshal(prefix)
+    if marshalled is None:
+        return None
+    pbuf, plen = marshalled
 
     def run(lo: int, hi: int) -> int:
         return int(
@@ -483,6 +516,109 @@ def pack_int32_native(
         if not run(0, n):
             return None
     return bytes(pbuf.raw[: plen.value]), out
+
+
+def pack_int32_strided_native(
+    combined: np.ndarray,
+    starts: np.ndarray,
+    lens: np.ndarray,
+    n_records: int,
+    stride: int,
+    off: int,
+    prefix: "bytes | None",
+):
+    """Strided typed-lane parse for RECTANGULAR chunks: column *off* of
+    record i is flat field ``off + i*stride`` — no per-column position
+    gather.  Same contract as :func:`pack_int32_native`."""
+    try:
+        lib = _load()
+    except ImportError:
+        return None
+    if n_records == 0:
+        return None
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    out = np.empty(n_records, dtype=np.int32)
+    marshalled = _prefix_marshal(prefix)
+    if marshalled is None:
+        return None
+    pbuf, plen = marshalled
+    ok = int(
+        lib.csv_pack_int32_strided(
+            combined.ctypes.data,
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n_records,
+            stride,
+            off,
+            pbuf,
+            ctypes.byref(plen),
+            _PREFIX_CAP,
+            out.ctypes.data,
+        )
+    )
+    if not ok:
+        return None
+    return bytes(pbuf.raw[: plen.value]), out
+
+
+def scan_parse_i32_native(
+    data: bytes, delimiter: str, ncols: int, header, typed_state
+):
+    """FUSED tokenize + typed parse of a fully-typed rectangular chunk:
+    one C++ pass emits the selected columns' int32 affix values with no
+    (start, len) offset arrays at all.  Requires every selected column
+    in typed mode with an established prefix.  Returns
+    ``(nrec, {name: ("int", prefix, values)})`` or None to bail (the
+    caller reruns the chunk through the generic scan)."""
+    try:
+        lib = _load()
+    except ImportError:
+        return None
+    n = len(data)
+    if n == 0 or ncols <= 0:
+        return None
+    # a typed record needs >= 1 digit per selected field; the tightest
+    # arity-independent bound is one byte per field + separators
+    max_records = n // (2 * ncols) + 2
+    outs = {}
+    ptrs = (ctypes.c_void_p * ncols)()
+    blob = bytearray()
+    poff = np.zeros(ncols, dtype=np.int64)
+    plen = np.zeros(ncols, dtype=np.int64)
+    for name, idx in header.items():
+        st = typed_state.get(name)
+        if st is None or st[0] is None or idx >= ncols:
+            return None
+        arr = np.empty(max_records, dtype=np.int32)
+        outs[name] = (idx, arr)
+        ptrs[idx] = arr.ctypes.data
+        poff[idx] = len(blob)
+        plen[idx] = len(st[0])
+        blob.extend(st[0])
+    base = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p).value
+    rc = int(
+        lib.csv_scan_parse_i32(
+            base,
+            n,
+            delimiter.encode("utf-8"),
+            ncols,
+            bytes(blob),
+            poff.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            plen.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ptrs,
+            max_records,
+        )
+    )
+    if rc <= 0:
+        return None
+    # COPY the used slice: a view would pin the full max_records buffer
+    # (typically 3-6x the real row count) across the consumer's whole
+    # accumulation, breaking the one-chunk host-memory bound
+    return rc, {
+        name: ("int", typed_state[name][0], np.ascontiguousarray(arr[:rc]))
+        for name, (idx, arr) in outs.items()
+    }
 
 
 def format_i32_native(values: np.ndarray, width: int = 12):
@@ -969,6 +1105,7 @@ def stream_encoded_chunks(
     # per-column typed state: [prefix bytes | None] while eligible
     # (None = derive from the first cell), absent key = dictionary mode
     typed_state: "Dict[str, list]" = {}
+    fused_ncols = 0  # record arity for the fused pass (0 = ineligible)
 
     with open(path, "rb") as f:
         pending = b""
@@ -1020,6 +1157,47 @@ def stream_encoded_chunks(
                     data, pending = pending + raw[:cut], raw[cut:]
             if b"\x00" in data:
                 raise StreamFallback("NUL in chunk")
+            # FUSED fast path (chunks after the first): when every
+            # selected column is typed with an established prefix and the
+            # chunk is plain (no quotes/CR/comments), ONE C++ pass
+            # tokenizes and int-parses the whole chunk without writing
+            # field offsets at all — the two-pass scan+parse writes and
+            # re-reads ~12 bytes of offsets per field, which dominated
+            # the single-core 100M-row ingest profile.  Any bail (record
+            # arity, non-conforming cell) reruns the chunk through the
+            # generic path below, which owns exact error numbering.
+            _delim_b = reader._delimiter.encode("utf-8")
+            if (
+                header is not None
+                and fused_ncols
+                and typed_state
+                and reader._comment is None
+                and len(typed_state) == len(header)
+                and all(
+                    st[0] is not None
+                    # a prefix containing the delimiter or a record
+                    # terminator (possible via quoted cells in earlier
+                    # chunks) would let the fused parser's prefix memcmp
+                    # read across field boundaries and misparse — those
+                    # columns keep the tokenized path
+                    and _delim_b not in st[0]
+                    and b"\n" not in st[0]
+                    and b"\r" not in st[0]
+                    for st in typed_state.values()
+                )
+                and b'"' not in data
+                and b"\r" not in data
+            ):
+                fused = scan_parse_i32_native(
+                    data, reader._delimiter, fused_ncols, header, typed_state
+                )
+                if fused is not None:
+                    # fused records are structurally exact-arity, so the
+                    # locked field-count policy holds by construction
+                    nrec, typed_cols = fused
+                    next_record += nrec
+                    yield names, typed_cols, nrec
+                    continue
             try:
                 # chunks start at record boundaries with closed quote
                 # state, so the multi-threaded newline-split scan applies
@@ -1047,6 +1225,12 @@ def stream_encoded_chunks(
                 first_data_record = rec_base
                 if typed_enabled:
                     typed_state = {n: [None] for n in names}
+                    if expected and expected > 0:
+                        fused_ncols = int(expected)
+                    elif data_counts.size and int(data_counts.min()) == int(
+                        data_counts.max()
+                    ):
+                        fused_ncols = int(data_counts[0])
             else:
                 field_offset = 0
                 data_counts = counts
@@ -1068,11 +1252,45 @@ def stream_encoded_chunks(
                 if scratch
                 else starts
             )
+            # RECTANGULAR fast path for typed columns: uniform field
+            # counts + no scratch means column idx of record r is flat
+            # field field_offset + r*nf + idx — the strided C++ parse
+            # reads it directly, skipping the per-column position-array
+            # construction and gathers (the single-core ingest profile's
+            # second-largest cost after the scan itself)
+            typed_out = {}
+            nrec = int(data_counts.shape[0])
+            uniform_nf = 0
+            if typed_state and not scratch and nrec:
+                mn, mx = int(data_counts.min()), int(data_counts.max())
+                if mn == mx:
+                    uniform_nf = mn
+            if uniform_nf:
+                for name, idx in header.items():
+                    st = typed_state.get(name)
+                    if st is None or idx >= uniform_nf:
+                        continue
+                    packed = pack_int32_strided_native(
+                        combined,
+                        starts,
+                        lens,
+                        nrec,
+                        uniform_nf,
+                        field_offset + idx,
+                        st[0],
+                    )
+                    if packed is None:
+                        typed_state.pop(name, None)
+                        continue
+                    st[0] = packed[0]
+                    typed_out[name] = ("int", packed[0], packed[1])
+
             cols = list(
                 _column_positions(
                     data_counts, field_offset, header, first_data_record, pad_allowed
                 )
-            )
+            ) if len(typed_out) < len(header) else []
+            cols = [c for c in cols if c[0] not in typed_out]
 
             def enc_one(args):
                 name, pos, ok = args
@@ -1117,7 +1335,8 @@ def stream_encoded_chunks(
                 if encoder is not None
                 else _map_columns(enc_one, cols)
             )
-            yield names, out, int(data_counts.shape[0])
+            out.update(typed_out)
+            yield names, out, nrec
 
 
 def _scan_for_reader(reader, path: str):

@@ -318,6 +318,31 @@ def test_quoted_typed_values_and_escaping_prefix(tmp_path):
     assert open(h, "rb").read() == open(d, "rb").read()
 
 
+def test_fused_path_rejects_delimiter_bearing_prefix(tmp_path, monkeypatch):
+    """Review r5 regression: a typed prefix containing the delimiter
+    (established via quoted cells) must keep the column on the tokenized
+    path — the fused parser's prefix memcmp would otherwise read across
+    field boundaries, misparse values, and swallow arity errors."""
+    monkeypatch.setenv("CSVPLUS_STREAM_CHUNK_BYTES", "96")
+    # chunk 1: quoted cells establish prefix b'a,b' for column A
+    body = '"a,b1",7\n"a,b2",8\n"a,b3",9\n"a,b4",1\n"a,b5",2\n"a,b6",3\n'
+    # later chunks are quote-free; a 3-field record must still ERROR
+    body += '"a,b7",4\n' * 6
+    body += "a,b8,5\n"  # wrong field count under the locked arity of 2
+    path = _write(tmp_path, "A,B\n" + body)
+    with pytest.raises(Exception, match="wrong number of fields"):
+        FromFile(path).on_device().to_rows()
+    # host oracle agrees
+    with pytest.raises(Exception, match="wrong number of fields"):
+        Take(FromFile(path)).to_rows()
+    # and a well-formed file of the same shape decodes identically
+    good = "A,B\n" + '"a,b1",7\n' * 20
+    gpath = _write(tmp_path, good, "good.csv")
+    assert _dicts(FromFile(gpath).on_device().to_rows()) == _dicts(
+        Take(FromFile(gpath)).to_rows()
+    )
+
+
 def test_typed_except_and_select(joined_files):
     opath, cpath, _ = joined_files
     small = Take(FromFile(cpath)).unique_index_on("id")
