@@ -354,6 +354,62 @@ def test_typed_except_and_select(joined_files):
     assert _dicts(a) == _dicts(b)
 
 
+from hypothesis import given, settings, strategies as st
+
+_PREFIXES = ["", "o", "c", "id-", "a,b", "00", "-", "é", " p"]
+# poisons exercise DISTINCT demotion branches: non-digit bail, int32
+# overflow, one-past-min (the PAD_VALUE sentinel's neighborhood), and a
+# digits-too-long bail
+_POISONS = ["ZZZ", "2147483648", "-2147483648", "99999999999"]
+
+
+@settings(deadline=None)  # max_examples comes from the conftest profile
+@given(
+    st.lists(
+        st.tuples(
+            st.sampled_from(_PREFIXES),
+            st.lists(
+                st.integers(min_value=-(2**31) + 1, max_value=2**31 - 1),
+                min_size=1,
+                max_size=40,
+            ),
+            st.sampled_from([None] + _POISONS),  # mid-column demotion
+        ),
+        min_size=1,
+        max_size=4,
+    ),
+    st.sampled_from([64, 256, 4096]),
+)
+def test_typed_hypothesis_differential(tmp_path_factory, cols, chunk):
+    """Random affix schemas (prefixes incl. delimiter/space/unicode edge
+    cases, full int32 range, optional mid-column demotion via distinct
+    non-conforming shapes) must decode identically to the host executor
+    at any chunk size."""
+    rows = max(len(v) for _, v, _ in cols)
+    names = [f"c{i}" for i in range(len(cols))]
+    lines = []
+    for r in range(rows):
+        cells = []
+        for prefix, vals, poison in cols:
+            v = vals[r % len(vals)]
+            cell = f"{prefix}{v}"
+            if poison is not None and r == rows // 2:
+                cell = poison  # breaks typing mid-file
+            if any(ch in cell for ch in ',"\n\r') or cell.startswith(" "):
+                cell = '"' + cell.replace('"', '""') + '"'
+            cells.append(cell)
+        lines.append(",".join(cells))
+    text = ",".join(names) + "\n" + "\n".join(lines) + "\n"
+    p = tmp_path_factory.mktemp("aff") / "t.csv"
+    p.write_bytes(text.encode("utf-8"))
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv("CSVPLUS_STREAM_MIN_BYTES", "1")
+        mp.setenv("CSVPLUS_STREAM_CHUNK_BYTES", str(chunk))
+        host = Take(FromFile(str(p))).to_rows()
+        dev = FromFile(str(p)).on_device().to_rows()
+        assert _dicts(host) == _dicts(dev)
+
+
 def test_typed_persistence_roundtrip(tmp_path, joined_files):
     from csvplus_tpu import load_index
 
