@@ -1,10 +1,24 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench dryrun example coldcheck
+.PHONY: test soak bench dryrun example coldcheck lint
 
 test:
 	python -m pytest tests/ -x -q
+
+# Static analysis gate (docs/ANALYSIS.md).  The repo AST lint (ctypes
+# boundary + jit retrace rules) always runs; ruff and mypy run when
+# installed (the baked toolchain image may not carry them) and their
+# configs live in pyproject.toml.  A tool that RUNS and finds issues
+# fails the target; a tool that is absent is reported and skipped.
+lint:
+	python -m csvplus_tpu.analysis csvplus_tpu
+	@if python -c "import ruff" >/dev/null 2>&1; then \
+		python -m ruff check csvplus_tpu tests; \
+	else echo "ruff not installed -- skipped"; fi
+	@if python -c "import mypy" >/dev/null 2>&1; then \
+		python -m mypy csvplus_tpu; \
+	else echo "mypy not installed -- skipped"; fi
 
 soak:
 	CSVPLUS_HYPOTHESIS_EXAMPLES=1000 python -m pytest tests/ -q

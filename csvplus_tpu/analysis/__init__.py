@@ -1,0 +1,40 @@
+"""Static analysis for the device pipeline.
+
+Two layers:
+
+* :mod:`.verify` + :mod:`.schema` — the plan-IR static verifier, run by
+  the executor before every lowering (``CSVPLUS_VERIFY=0`` disables);
+* :mod:`.astlint` — repo-specific AST lint (ctypes boundary, jit
+  retrace smells), run by ``make lint`` via ``python -m
+  csvplus_tpu.analysis``.
+
+See docs/ANALYSIS.md for the rule catalogue.
+"""
+
+from .astlint import LintFinding, lint_file, lint_paths, lint_source
+from .schema import Card, ColInfo, NodeState, Presence
+from .verify import (
+    EXECUTOR_MODEL,
+    Diagnostic,
+    ExecutorModel,
+    PlanReport,
+    verify_before_lower,
+    verify_plan,
+)
+
+__all__ = [
+    "Card",
+    "ColInfo",
+    "Diagnostic",
+    "EXECUTOR_MODEL",
+    "ExecutorModel",
+    "LintFinding",
+    "NodeState",
+    "PlanReport",
+    "Presence",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+    "verify_before_lower",
+    "verify_plan",
+]

@@ -1,0 +1,26 @@
+"""CLI for the repo AST lint: ``python -m csvplus_tpu.analysis <paths>``.
+
+Prints one ``path:line: CODE message`` per finding and exits nonzero
+when any finding survives suppression — the ``make lint`` contract.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .astlint import lint_paths
+
+
+def main(argv=None) -> int:
+    paths = (sys.argv[1:] if argv is None else argv) or ["csvplus_tpu"]
+    findings = lint_paths(paths)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

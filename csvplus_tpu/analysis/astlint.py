@@ -1,0 +1,324 @@
+"""Repo-specific AST lint: rules generic linters cannot know.
+
+Two boundary classes have bitten this codebase and are mechanically
+checkable from the AST:
+
+* **CTYPES001** — the native scanner boundary.  The C ABI's ``c_char``
+  takes EXACTLY one byte; ctypes raises a cryptic ``TypeError`` (or
+  silently truncates, for sliced bytes) when a multi-byte encoding of a
+  user-supplied delimiter/comment reaches it.  Every ``.encode(...)``
+  expression flowing into a ``c_char`` parameter position (positions are
+  discovered from the module's own ``lib.X.argtypes = [...]``
+  assignments) must be gated in the same function by a
+  ``len(<that expression>) == 1`` / ``!= 1`` test or an explicit
+  single-byte slice ``[0:1]``.  The round-5 fused-path bug — a
+  multi-byte delimiter reaching ``csv_scan_parse_i32`` ungated — is
+  exactly this rule.
+* **JIT001** — the retrace boundary.  A ``jax.jit``-ed function whose
+  body iterates one of its PARAMETERS in a comprehension has a
+  tuple-of-arrays signature: every distinct tuple LENGTH is a fresh
+  trace + compile (one per chunk-count in the ingest profile).  Such
+  kernels should be eager, take a fixed arity, or carry an explicit
+  suppression acknowledging the retrace cost.
+
+Suppression: a ``# analysis: allow[CODE]`` comment on the flagged line
+or on the enclosing ``def`` line.
+
+Run over the tree with ``python -m csvplus_tpu.analysis <paths...>``
+(wired into ``make lint``).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["LintFinding", "lint_source", "lint_file", "lint_paths"]
+
+
+@dataclass(frozen=True)
+class LintFinding:
+    code: str  # "CTYPES001" | "JIT001"
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _is_c_char(node: ast.expr) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "c_char") or (
+        isinstance(node, ast.Name) and node.id == "c_char"
+    )
+
+
+def _c_char_positions(tree: ast.Module) -> Dict[str, Tuple[int, ...]]:
+    """``{function_name: c_char argument positions}`` from every
+    ``<lib>.NAME.argtypes = [...]`` assignment in the module."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (
+            isinstance(tgt, ast.Attribute)
+            and tgt.attr == "argtypes"
+            and isinstance(tgt.value, ast.Attribute)
+        ):
+            continue
+        if not isinstance(node.value, (ast.List, ast.Tuple)):
+            continue
+        pos = tuple(
+            i for i, el in enumerate(node.value.elts) if _is_c_char(el)
+        )
+        if pos:
+            out[tgt.value.attr] = pos
+    return out
+
+
+def _find_encode(node: ast.expr) -> Optional[ast.Call]:
+    """The ``<something>.encode(...)`` call inside *node*, if any."""
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "encode"
+        ):
+            return sub
+    return None
+
+
+def _is_single_byte_slice(node: ast.expr) -> bool:
+    """``X[0:1]`` — an explicit truncation to at most one byte."""
+    if not (isinstance(node, ast.Subscript) and isinstance(node.slice, ast.Slice)):
+        return False
+    s = node.slice
+    return (
+        isinstance(s.lower, ast.Constant)
+        and s.lower.value == 0
+        and isinstance(s.upper, ast.Constant)
+        and s.upper.value == 1
+        and s.step is None
+    )
+
+
+def _len_one_guards(func: ast.AST) -> Set[str]:
+    """Unparsed sources ``X`` for every ``len(X) == 1`` / ``len(X) != 1``
+    comparison anywhere in *func* (either operand order)."""
+    out: Set[str] = set()
+
+    def record(len_side: ast.expr, const_side: ast.expr) -> None:
+        if (
+            isinstance(len_side, ast.Call)
+            and isinstance(len_side.func, ast.Name)
+            and len_side.func.id == "len"
+            and len(len_side.args) == 1
+            and isinstance(const_side, ast.Constant)
+            and const_side.value == 1
+        ):
+            out.add(ast.unparse(len_side.args[0]))
+
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            continue
+        if not isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+            continue
+        record(node.left, node.comparators[0])
+        record(node.comparators[0], node.left)
+    return out
+
+
+def _local_assignments(func: ast.AST) -> Dict[str, ast.expr]:
+    """Simple single-target ``name = expr`` bindings in *func* (last one
+    wins — good enough for the guard-resolution heuristic)."""
+    out: Dict[str, ast.expr] = {}
+    for node in ast.walk(func):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            out[node.targets[0].id] = node.value
+    return out
+
+
+class _FunctionStack(ast.NodeVisitor):
+    """Visitor that tracks the enclosing function for every node."""
+
+    def __init__(self) -> None:
+        self.stack: List[ast.AST] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    @property
+    def current(self) -> Optional[ast.AST]:
+        return self.stack[-1] if self.stack else None
+
+
+class _CtypesVisitor(_FunctionStack):
+    def __init__(self, positions: Dict[str, Tuple[int, ...]], path: str):
+        super().__init__()
+        self.positions = positions
+        self.path = path
+        self.findings: List[LintFinding] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr in self.positions):
+            return
+        func = self.current
+        guards = _len_one_guards(func) if func is not None else set()
+        local = _local_assignments(func) if func is not None else {}
+        for pos in self.positions[fn.attr]:
+            if pos >= len(node.args):
+                continue
+            arg = node.args[pos]
+            name = None
+            if isinstance(arg, ast.Name):
+                name = arg.id
+                arg = local.get(arg.id, arg)
+            enc = _find_encode(arg)
+            if enc is None:
+                continue
+            if _is_single_byte_slice(arg):
+                continue
+            gate_keys = {ast.unparse(arg), ast.unparse(enc)}
+            if name is not None:
+                gate_keys.add(name)
+            if gate_keys & guards:
+                continue
+            self.findings.append(
+                LintFinding(
+                    "CTYPES001",
+                    self.path,
+                    node.args[pos].lineno,
+                    f"{ast.unparse(enc)} flows into c_char parameter "
+                    f"{pos} of {fn.attr} without a len(...) == 1 gate "
+                    "in the enclosing function",
+                )
+            )
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    """``@jax.jit``, ``@jit``, or any decorator CALL mentioning ``jit``
+    (``functools.partial(jax.jit, ...)``)."""
+    for node in ast.walk(dec):
+        if isinstance(node, ast.Attribute) and node.attr == "jit":
+            return True
+        if isinstance(node, ast.Name) and node.id == "jit":
+            return True
+    return False
+
+
+class _JitVisitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[LintFinding] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.generic_visit(node)
+        if not any(_is_jit_decorator(d) for d in node.decorator_list):
+            return
+        params = {
+            a.arg
+            for a in (
+                node.args.posonlyargs + node.args.args + node.args.kwonlyargs
+            )
+        }
+
+        def iterates_param(it: ast.expr) -> Optional[str]:
+            if isinstance(it, ast.Name) and it.id in params:
+                return it.id
+            # zip(maps, cks) / enumerate(cks) over parameters
+            if isinstance(it, ast.Call):
+                for a in it.args:
+                    if isinstance(a, ast.Name) and a.id in params:
+                        return a.id
+            return None
+
+        # one finding per function: the signature is the problem, not
+        # each comprehension that exhibits it
+        for sub in ast.walk(node):
+            if isinstance(
+                sub, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.For)
+            ):
+                its = (
+                    [g.iter for g in sub.generators]
+                    if not isinstance(sub, ast.For)
+                    else [sub.iter]
+                )
+                for it in its:
+                    hit = iterates_param(it)
+                    if hit is not None:
+                        self.findings.append(
+                            LintFinding(
+                                "JIT001",
+                                self.path,
+                                sub.lineno,
+                                f"jit-compiled `{node.name}` iterates "
+                                f"parameter `{hit}`: a tuple-of-arrays "
+                                "signature retraces per distinct length",
+                            )
+                        )
+                        return
+
+
+def _suppressed(finding: LintFinding, lines: List[str], tree: ast.Module) -> bool:
+    marker = f"analysis: allow[{finding.code}]"
+
+    def line_has(idx: int) -> bool:
+        return 0 < idx <= len(lines) and marker in lines[idx - 1]
+
+    if line_has(finding.line):
+        return True
+    # any enclosing def line (a flagged closure inherits its outer
+    # function's acknowledgment)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= finding.line <= end and line_has(node.lineno):
+                return True
+    return False
+
+
+def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
+    """All unsuppressed findings for one module's source text."""
+    tree = ast.parse(source, filename=path)
+    findings: List[LintFinding] = []
+    positions = _c_char_positions(tree)
+    if positions:
+        v = _CtypesVisitor(positions, path)
+        v.visit(tree)
+        findings.extend(v.findings)
+    j = _JitVisitor(path)
+    j.visit(tree)
+    findings.extend(j.findings)
+    lines = source.splitlines()
+    findings = [f for f in findings if not _suppressed(f, lines, tree)]
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+def lint_file(path) -> List[LintFinding]:
+    p = Path(path)
+    return lint_source(p.read_text(encoding="utf-8"), str(p))
+
+
+def lint_paths(paths: Iterable) -> List[LintFinding]:
+    """Lint every ``.py`` file under each path (file or directory)."""
+    findings: List[LintFinding] = []
+    for path in paths:
+        p = Path(path)
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            findings.extend(lint_file(f))
+    return findings

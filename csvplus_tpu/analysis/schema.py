@@ -1,0 +1,134 @@
+"""Abstract domain for the plan-IR static verifier.
+
+Relational compilers validate column resolution, arity, and type flow
+over the plan before codegen (arXiv:2502.06988); this module defines the
+lattices that analysis runs over:
+
+* **Presence** — what the schema says about one column name at one plan
+  node: every row has the cell (``PRESENT``), some rows may lack it
+  (``MAYBE``), or the name is not in the schema at all (``ABSENT``).
+  The distinction matters because the host path's errors are *per
+  streamed row* (csvplus.go:511-525): selecting an ``ABSENT`` column is
+  an error only if a row actually streams, so the verifier must weigh
+  presence against cardinality rather than reject outright.
+* **Card** — the node's row-count lattice point: statically zero rows
+  (``EMPTY``), possibly zero (``MAYBE_EMPTY``), or at least one row
+  guaranteed (``NONEMPTY``).  ``EMPTY`` is the exact lattice point the
+  round-5 differential suite exposed (empty selection + missing-column
+  select), so every operator's transfer function is checked against it.
+* **lane** — the physical column representation the device executor
+  would lower against: dictionary codes (``"str"``) or typed affix
+  int32 value lanes (``"int"``).  Placeholder columns (installed by
+  ``SelectCols`` of a missing name over an empty selection) are tracked
+  explicitly: they are 0-length and must never be gathered with live
+  row ids.
+
+The domain is deliberately cheap: states are built from table/column
+*metadata* only (no device syncs — a column whose ``has_absent`` is not
+yet cached is conservatively ``MAYBE``), so verification is O(plan
+nodes x columns) and can run before every lowering.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+from typing import Dict
+
+
+class Presence(enum.Enum):
+    PRESENT = "present"  # every row has the cell
+    MAYBE = "maybe"  # some rows may lack the cell
+    ABSENT = "absent"  # name not in the schema at all
+
+    def __repr__(self) -> str:  # compact diagnostics
+        return self.value
+
+
+class Card(enum.Enum):
+    """Row-count lattice: EMPTY <= MAYBE_EMPTY, NONEMPTY <= MAYBE_EMPTY."""
+
+    EMPTY = "empty"  # statically zero rows
+    MAYBE_EMPTY = "maybe-empty"  # could be zero
+    NONEMPTY = "nonempty"  # at least one row guaranteed
+
+    def __repr__(self) -> str:
+        return self.value
+
+    @property
+    def may_be_empty(self) -> bool:
+        return self is not Card.NONEMPTY
+
+    def narrowed(self) -> "Card":
+        """The cardinality after any row-dropping operator (filter,
+        windowing cut, anti-join): a NONEMPTY input may come out empty,
+        an EMPTY input stays empty."""
+        return Card.EMPTY if self is Card.EMPTY else Card.MAYBE_EMPTY
+
+
+@dataclass(frozen=True)
+class ColInfo:
+    """What the verifier knows about one column at one plan node."""
+
+    lane: str  # "str" (dictionary codes) | "int" (typed int32 lanes)
+    presence: Presence
+    placeholder: bool = False  # 0-length stand-in from select-of-missing
+
+    def __repr__(self) -> str:
+        tag = f"{self.lane}/{self.presence.value}"
+        return f"<{tag}{'/placeholder' if self.placeholder else ''}>"
+
+
+@dataclass
+class NodeState:
+    """The abstract relation flowing OUT of one plan node."""
+
+    schema: Dict[str, ColInfo] = field(default_factory=dict)
+    card: Card = Card.MAYBE_EMPTY
+
+    def copy(self) -> "NodeState":
+        return NodeState(dict(self.schema), self.card)
+
+    def presence(self, name: str) -> Presence:
+        info = self.schema.get(name)
+        return info.presence if info is not None else Presence.ABSENT
+
+    def with_card(self, card: Card) -> "NodeState":
+        return NodeState(dict(self.schema), card)
+
+
+def col_info_for(column) -> ColInfo:
+    """ColInfo from a live table column, using only cached metadata.
+
+    ``IntColumn`` never holds absent cells (typed.py's representation
+    contract), so typed lanes are always PRESENT.  ``StringColumn``
+    presence comes from the ``_has_absent`` cache when already known;
+    an uncached value stays MAYBE rather than forcing a device sync.
+    """
+    if getattr(column, "kind", "str") == "int":
+        return ColInfo("int", Presence.PRESENT)
+    cached = getattr(column, "_has_absent", None)
+    if cached is False:
+        return ColInfo("str", Presence.PRESENT)
+    if cached is True:
+        return ColInfo("str", Presence.MAYBE)
+    return ColInfo("str", Presence.MAYBE)
+
+
+def scan_state(table) -> NodeState:
+    """The abstract state of a ``Scan`` node's device table."""
+    schema = {name: col_info_for(col) for name, col in table.columns.items()}
+    nrows = int(getattr(table, "nrows", 0))
+    card = Card.NONEMPTY if nrows > 0 else Card.EMPTY
+    return NodeState(schema, card)
+
+
+def placeholder_col() -> ColInfo:
+    """The 0-length placeholder ``SelectCols`` installs for a missing
+    name over an empty selection (columnar/exec.py ``_apply_select``)."""
+    return ColInfo("str", Presence.MAYBE, placeholder=True)
+
+
+def demoted(info: ColInfo) -> ColInfo:
+    """Lane state after a typed column is demoted to dictionary codes."""
+    return replace(info, lane="str") if info.lane == "int" else info

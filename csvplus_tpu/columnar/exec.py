@@ -117,13 +117,7 @@ class _View:
 
 
 def _linearize(node: P.PlanNode) -> List[P.PlanNode]:
-    chain: List[P.PlanNode] = []
-    while not isinstance(node, P.Scan):
-        chain.append(node)
-        node = node.child
-    chain.append(node)
-    chain.reverse()
-    return chain
+    return P.linearize(node)
 
 
 def execute_plan(root: P.PlanNode) -> DeviceTable:
@@ -137,7 +131,18 @@ def execute_plan(root: P.PlanNode) -> DeviceTable:
 
 def execute_plan_view(root: P.PlanNode) -> "_View":
     """Run the plan, returning the final executor view (columns +
-    selection vector + source row numbering) without materializing."""
+    selection vector + source row numbering) without materializing.
+
+    The static verifier (:mod:`csvplus_tpu.analysis`) runs first:
+    unlowerable plans raise :class:`UnsupportedPlan` BEFORE any device
+    work (the caller falls back to the host path exactly as it would
+    have mid-execution), and invalid column references are known up
+    front rather than discovered one stage at a time.  ``CSVPLUS_VERIFY=0``
+    is the escape hatch back to the unverified lowering.
+    """
+    from ..analysis import verify_before_lower
+
+    verify_before_lower(root)
     stages = _linearize(root)
     # Validate lowers only as the FINAL stage.  Upstream of anything
     # else, the host's push semantics (check rows one by one, stop the
@@ -316,6 +321,14 @@ def _sel_mask(view: _View, pred):
 
     nrows = _full_len(view)
     sel_n = int(view.sel.shape[0])
+    if sel_n == 0:
+        # an empty selection matches nothing, and the narrow-selection
+        # pad below must never run: it would pad with row id 0 and
+        # gather row 0 out of columns that may be 0-length placeholders
+        # (SelectCols of a missing name over an empty selection installs
+        # those) — the round-5 differential crash.  The host path is
+        # vacuous on an empty stream; so are we.
+        return jnp.zeros(0, dtype=bool)
     try:
         if 4 * sel_n < nrows:
             padded = 1 << max(sel_n - 1, 0).bit_length() if sel_n else 1

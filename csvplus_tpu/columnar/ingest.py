@@ -251,6 +251,10 @@ def _stream_to_table(reader, path: str, device, mesh=None) -> DeviceTable:
 
     int_vals: "dict[str, list]" = {}  # typed mode: device value chunks
     int_prefix: "dict[str, bytes]" = {}
+    # columns that left typed mode at any point: they must NEVER re-enter
+    # it, or finalize's IntColumn branch would silently drop the
+    # dictionary chunks accumulated in between
+    int_demoted: "set[str]" = set()
 
     def add_dict_chunk(c, d, codes, tgt=None):
         """One chunk's (dictionary, codes) through the dictionary-path
@@ -319,6 +323,7 @@ def _stream_to_table(reader, path: str, device, mesh=None) -> DeviceTable:
         Each re-encoded chunk stays on the device its values live on."""
         from .typed import format_affix
 
+        int_demoted.add(c)
         for dev_arr in int_vals[c]:
             v = np.asarray(dev_arr).astype(np.int32)
             strs = format_affix(int_prefix[c], v)
@@ -362,6 +367,24 @@ def _stream_to_table(reader, path: str, device, mesh=None) -> DeviceTable:
             enc = encoded[c]
             if len(enc) == 3 and enc[0] == "int":
                 _, prefix, vals = enc
+                if c in int_demoted or (
+                    c in int_prefix and int_prefix[c] != prefix
+                ):
+                    # prefix drift (or a column that already left typed
+                    # mode): the established IntColumn prefix cannot hold
+                    # this chunk.  Demote what accumulated and re-encode
+                    # THIS chunk through the dictionary path too —
+                    # overwriting int_prefix here would reinterpret every
+                    # earlier chunk's values under the wrong affix.
+                    from .typed import format_affix
+
+                    if int_vals.get(c):
+                        demote_typed(c)
+                    int_demoted.add(c)
+                    strs = format_affix(prefix, vals.astype(np.int32))
+                    dd, cc = np.unique(strs, return_inverse=True)
+                    add_dict_chunk(c, dd, cc.astype(np.int32), tgt=tgt)
+                    continue
                 int_prefix[c] = prefix
                 # narrow the upload to the smallest dtype holding the
                 # chunk's value range; device concat restores int32
@@ -397,6 +420,10 @@ def _stream_to_table(reader, path: str, device, mesh=None) -> DeviceTable:
         if int_vals.get(c):
             from .typed import IntColumn
 
+            # the int_demoted bookkeeping above guarantees a column with
+            # typed chunks never also holds dictionary/lane chunks —
+            # this branch would silently drop them
+            assert not chunk_dicts[c] and not chunk_lanes[c] and not chunk_codes[c]
             out[c] = IntColumn(int_prefix[c], _values_concat(tuple(int_vals[c])))
             continue
         dicts, codes = chunk_dicts[c], chunk_codes[c]
@@ -525,7 +552,9 @@ def _offset_concat(codes, offsets):
         import jax.numpy as jnp
 
         @functools.partial(jax.jit, static_argnames=("offs",))
-        def kernel(cks, offs):
+        def kernel(cks, offs):  # analysis: allow[JIT001]
+            # the static offs tuple already keys one executable per
+            # chunk layout; the add+concat fusion is the point
             return jnp.concatenate(
                 [c.astype(jnp.int32) + o for c, o in zip(cks, offs)]
             )
@@ -628,6 +657,7 @@ def _finalize_sharded(
             if int_vals.get(c):
                 from .typed import PAD_VALUE
 
+                assert not chunk_dicts[c] and not chunk_codes[c]
                 arrs = [
                     a if a.dtype == jnp.int32 else a.astype(jnp.int32)
                     for a in int_vals[c]
@@ -673,23 +703,17 @@ def _finalize_sharded(
     return table
 
 
-_values_kernel = None
-
-
 def _values_concat(chunks):
     """Concatenate per-chunk (narrow-uploaded) value arrays into one
-    int32 device array — one jitted call for the whole typed column."""
-    global _values_kernel
-    if _values_kernel is None:
-        import jax
-        import jax.numpy as jnp
+    int32 device array.
 
-        @jax.jit
-        def kernel(cks):
-            return jnp.concatenate([c.astype(jnp.int32) for c in cks])
+    Deliberately EAGER: chunk count grows with file size, so a jitted
+    tuple-of-arrays kernel would retrace (trace + XLA compile, tens of
+    ms) for every distinct chunk count — far more than the fusion ever
+    saved on a once-per-column concatenation."""
+    import jax.numpy as jnp
 
-        _values_kernel = kernel
-    return _values_kernel(chunks)
+    return jnp.concatenate([c.astype(jnp.int32) for c in chunks])
 
 
 _remap_kernel = None
@@ -702,7 +726,10 @@ def _remap_concat(mappings, codes):
         import jax.numpy as jnp
 
         @jax.jit
-        def kernel(maps, cks):
+        def kernel(maps, cks):  # analysis: allow[JIT001]
+            # retrace-per-chunk-count accepted HERE (unlike
+            # _values_concat): the per-chunk takes must fuse into the
+            # concatenation or each chunk materializes twice
             return jnp.concatenate(
                 [jnp.take(m, c, axis=0) for m, c in zip(maps, cks)]
             )

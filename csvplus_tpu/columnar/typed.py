@@ -25,8 +25,9 @@ Representation contract:
 * ``prefix``: bytes, constant for the column.
 * typed columns NEVER hold absent cells (CSV cells always exist; ops
   that would introduce absence demote first), so ``has_absent`` is
-  always False and sharding pads use value 0 (pad rows live beyond
-  ``nrows``, outside every selection).
+  always False and sharding pads use :data:`PAD_VALUE` (INT32_MIN —
+  pad rows live beyond ``nrows``, outside every selection, and the
+  sentinel can never collide with a real cell; see its comment).
 
 Anything that needs dictionary semantics (code order == lex order:
 sorts, index builds, packed join keys, persistence, point lookups)

@@ -66,7 +66,7 @@ def gather_columns(ids: jax.Array, valid: jax.Array, *code_arrays: jax.Array):
 
 
 @jax.jit
-def _fused_unique_join(cum_c, cum_p, qk_c, qk_p, cust_codes, prod_codes):
+def _fused_unique_join(cum_c, cum_p, qk_c, qk_p, cust_codes, prod_codes):  # analysis: allow[JIT001] — arity fixed per pipeline shape
     """The whole all-matched flagship join as ONE dispatch: two
     dictionary-direct probes (ops/join.direct_probe_parts — the single
     definition of the direct tier's semantics), the validity reduction,

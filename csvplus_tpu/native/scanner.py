@@ -224,6 +224,13 @@ def scan_bytes(
     (the parallel chunker's path); returned starts are range-relative.
     """
     lib = _load()
+    delim_b = delimiter.encode("utf-8")
+    if len(delim_b) != 1:
+        # the native scanners take the delimiter as a single C char;
+        # callers gate multi-byte delimiters onto the Python path, so
+        # reaching here is a programming error — fail loudly instead of
+        # letting ctypes raise an opaque TypeError (CTYPES001)
+        raise ValueError(f"native scan requires a 1-byte delimiter, got {delimiter!r}")
     n = len(data) - offset if length is None else length
     base = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p).value + offset
     max_fields = ctypes.c_int64(0)
@@ -233,7 +240,7 @@ def scan_bytes(
     lib.csv_count_bounds(
         base,
         n,
-        delimiter.encode("utf-8"),
+        delim_b,
         comment_b,
         ctypes.byref(max_fields),
         ctypes.byref(max_records),
@@ -262,7 +269,7 @@ def scan_bytes(
             lib.csv_scan_simple(
                 base,
                 n,
-                delimiter.encode("utf-8"),
+                delim_b,
                 starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                 lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
                 counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
@@ -280,7 +287,7 @@ def scan_bytes(
     rc = lib.csv_scan(
         base,
         n,
-        delimiter.encode("utf-8"),
+        delim_b,
         (comment or "\x00").encode("utf-8")[0:1],
         # multi-byte comments are ignored CONSISTENTLY across both native
         # paths: the simple tokenizer can't honor them, so the full
@@ -575,6 +582,13 @@ def scan_parse_i32_native(
         lib = _load()
     except ImportError:
         return None
+    delim_b = delimiter.encode("utf-8")
+    if len(delim_b) != 1:
+        # csv_scan_parse_i32 takes the delimiter as one C char; a
+        # multi-byte delimiter must bail to the generic scan (which the
+        # streaming caller gates onto the Python path) rather than reach
+        # ctypes, which would raise instead of returning None
+        return None
     n = len(data)
     if n == 0 or ncols <= 0:
         return None
@@ -601,7 +615,7 @@ def scan_parse_i32_native(
         lib.csv_scan_parse_i32(
             base,
             n,
-            delimiter.encode("utf-8"),
+            delim_b,
             ncols,
             bytes(blob),
             poff.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
@@ -1171,6 +1185,9 @@ def stream_encoded_chunks(
                 header is not None
                 and fused_ncols
                 and typed_state
+                # the fused C++ pass takes the delimiter as ONE char;
+                # multi-byte delimiters must take the generic path
+                and len(_delim_b) == 1
                 and reader._comment is None
                 and len(typed_state) == len(header)
                 and all(

@@ -27,6 +27,34 @@ class UnsupportedPredicate(Exception):
     """Raised when a predicate cannot be lowered (opaque Python callable)."""
 
 
+def predicate_columns(pred):
+    """Ordered, de-duplicated column names referenced by *pred*, or
+    ``None`` when the predicate tree contains a node :func:`build_mask`
+    cannot lower.
+
+    This is the static mirror of the lowering below — the plan verifier
+    (:mod:`csvplus_tpu.analysis`) calls it so "which columns does this
+    stage touch" and "can this stage lower at all" have exactly one
+    definition.  Keep the isinstance dispatch here in sync with
+    :func:`build_mask`.
+    """
+    out: list = []
+
+    def visit(p) -> bool:
+        if isinstance(p, Like):
+            for col in p.match:
+                if col not in out:
+                    out.append(col)
+            return True
+        if isinstance(p, (All, Any_)):
+            return all(visit(q) for q in p.preds)
+        if isinstance(p, Not):
+            return visit(p.pred)
+        return False
+
+    return out if visit(pred) else None
+
+
 def _group_by_column(terms):
     """Merge (codes, target) terms that reference the same column into
     (codes, [targets...]) so a k-value IN-list streams its column once."""

@@ -185,6 +185,29 @@ def _build_direct_cum(keys: jax.Array, total_bits: int) -> jax.Array:
     return jnp.cumsum(hist)
 
 
+def device_index_static_info(index):
+    """Static shape of an index's device copy, for the plan verifier:
+    ``(column -> lane kind, key column tuple, supported)`` — or ``None``
+    when the index carries no device table (the executor then raises
+    ``UnsupportedPlan`` and the chain falls back to the host path).
+
+    Reads only metadata the :class:`DeviceIndex` already holds; never
+    touches device arrays, so verification stays O(plan), not O(rows).
+    """
+    dev = getattr(index, "device_table", None)
+    if dev is None:
+        return None
+    if not getattr(dev, "supported", False):
+        # an unsupported device copy may hold no packed table at all —
+        # report the flag without assuming any further structure
+        return ({}, (), False)
+    return (
+        {n: c.kind for n, c in dev.table.columns.items()},
+        tuple(dev.key_columns),
+        True,
+    )
+
+
 @dataclass
 class DeviceIndex:
     """Columnar build side of a join: table + packed sorted keys."""
@@ -725,7 +748,7 @@ def join_tables(
 
 
 @jax.jit
-def _gather_both_sides(build_codes, stream_codes, build_ids, probe_ids):
+def _gather_both_sides(build_codes, stream_codes, build_ids, probe_ids):  # analysis: allow[JIT001] — arity fixed per pipeline shape
     b_idx = jnp.asarray(build_ids, dtype=jnp.int32)
     p_idx = jnp.asarray(probe_ids, dtype=jnp.int32)
     return (
@@ -735,7 +758,7 @@ def _gather_both_sides(build_codes, stream_codes, build_ids, probe_ids):
 
 
 @jax.jit
-def _gather_cols(codes, ids):
+def _gather_cols(codes, ids):  # analysis: allow[JIT001] — arity fixed per pipeline shape
     idx = jnp.asarray(ids, dtype=jnp.int32)
     return tuple(jnp.take(c, idx, axis=0) for c in codes)
 

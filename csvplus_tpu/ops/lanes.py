@@ -199,7 +199,7 @@ def union_device(
 
 
 @jax.jit
-def _translate_kernel(build_lanes: Tuple, query_lanes: Tuple):
+def _translate_kernel(build_lanes: Tuple, query_lanes: Tuple):  # analysis: allow[JIT001] — arity fixed per pipeline shape
     """query dictionary slot -> build dictionary slot (or -1): k-lane
     searchsorted + equality verification, all on device."""
     pos = searchsorted_lanes(build_lanes, query_lanes, side="left")

@@ -38,7 +38,7 @@ def _use_interpret() -> bool:
 @functools.partial(
     jax.jit, static_argnames=("mode", "targets", "interpret")
 )
-def _fused_mask_call(
+def _fused_mask_call(  # analysis: allow[JIT001] — arity fixed per pipeline shape
     mode: str,
     targets: "Tuple[Tuple[int, ...], ...]",
     interpret: bool,

@@ -75,7 +75,7 @@ from functools import partial as _partial
 
 
 @_partial(jax.jit, static_argnames=("lanes",))
-def _encode_column_kernel(data, starts, lens, lanes: int = 2):
+def _encode_column_kernel(data, starts, lens, lanes: int = 2):  # analysis: allow[JIT001] — arity fixed per pipeline shape
     """Device dictionary-encode one column of fields (<= 4*lanes bytes).
 
     Fields are gathered into NUL-padded byte matrices and packed

@@ -91,7 +91,14 @@ def _dsort_shard_kernel(
     # over the axes in mesh-major order (mesh.row_spec)
     flat = jnp.int32(0)
     for ax in axes:
-        flat = flat * lax.axis_size(ax) + lax.axis_index(ax)
+        # lax.axis_size is absent from older jax; psum of 1 over the
+        # axis is the same static size
+        size = (
+            lax.axis_size(ax)
+            if hasattr(lax, "axis_size")
+            else lax.psum(jnp.int32(1), ax)
+        )
+        flat = flat * size + lax.axis_index(ax)
     my_pos = flat * m + jnp.arange(m, dtype=jnp.int32)
     valid_in = (my_pos < n_true).astype(jnp.int32)
 
@@ -180,7 +187,7 @@ def _dsort_shard_kernel(
     jax.jit,
     static_argnames=("mesh", "n_shards", "capacity", "samples", "n_lanes", "n_true"),
 )
-def _dsort_spmd(
+def _dsort_spmd(  # analysis: allow[JIT001] — arity fixed per pipeline shape
     mesh, n_shards, capacity, samples, n_lanes, n_true, lanes, payload
 ):
     """Jitted launcher: pad to mesh divisibility ON DEVICE, shard, run

@@ -18,7 +18,7 @@ upstream plan is ``None`` or the stage argument is not symbolic.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
 
 
 class PlanNode:
@@ -28,6 +28,30 @@ class PlanNode:
 
     def describe(self, indent: int = 0) -> str:
         return " " * indent + repr(self)
+
+
+def linearize(root: "PlanNode") -> "List[PlanNode]":
+    """The plan chain in EXECUTION order: ``[Scan, stage1, ..., root]``.
+
+    Plans are single-child chains (every combinator wraps exactly one
+    upstream; Join/Except reference their build side as an *attribute*,
+    not a child), so this is the one canonical traversal — shared by the
+    device executor and the static verifier so they can never disagree
+    about stage order.
+    """
+    chain: List[PlanNode] = []
+    node = root
+    while not isinstance(node, Scan):
+        chain.append(node)
+        node = node.child  # type: ignore[attr-defined]
+    chain.append(node)
+    chain.reverse()
+    return chain
+
+
+def walk(root: "PlanNode") -> "Iterator[PlanNode]":
+    """Yield every node of the chain in execution order."""
+    yield from linearize(root)
 
 
 @dataclass(frozen=True)

@@ -18,7 +18,7 @@ from __future__ import annotations
 import contextlib
 import time
 from dataclasses import dataclass, field
-from typing import Iterator, List
+from typing import Dict, Iterator, List
 
 
 @dataclass
@@ -51,14 +51,24 @@ class Telemetry:
     # evidence that the multi-chip probe path crosses O(1)-ish data per
     # stage, not O(n) (VERDICT round-2 weak #3's done criterion)
     host_sync_elements: int = 0
+    # generic named counters for subsystems whose evidence is a tally,
+    # not a stage timing — e.g. the plan verifier's diagnostics-per-rule
+    # counts ("verify.resolution", "verify.divergence-risk", ...)
+    counters: Dict[str, int] = field(default_factory=dict)
 
     def reset(self) -> None:
         self.records.clear()
         self.host_sync_elements = 0
+        self.counters.clear()
 
     def count_sync(self, n: int) -> None:
         if self.enabled:
             self.host_sync_elements += int(n)
+
+    def count(self, name: str, n: int = 1) -> None:
+        """Bump a named counter (no-op unless collection is enabled)."""
+        if self.enabled:
+            self.counters[name] = self.counters.get(name, 0) + int(n)
 
     @contextlib.contextmanager
     def collect(self) -> Iterator[List[StageRecord]]:

@@ -231,13 +231,19 @@ def orders_csv(corpus) -> str:
 # hypothesis scale knob: CSVPLUS_HYPOTHESIS_EXAMPLES=N runs the property
 # suites at N examples (soak testing); the default "ci" profile stays
 # fast.  Per-test @settings must NOT pin max_examples or they would
-# override these profiles.
-import hypothesis as _hyp
+# override these profiles.  hypothesis is an optional test dependency:
+# without it the property tests skip (tests/hypo_compat.py) and the
+# profiles are moot.
+try:
+    import hypothesis as _hyp
+except ModuleNotFoundError:
+    _hyp = None
 
-_hyp.settings.register_profile("ci", max_examples=100, deadline=None)
-_n = os.environ.get("CSVPLUS_HYPOTHESIS_EXAMPLES")
-if _n:
-    _hyp.settings.register_profile("soak", max_examples=int(_n), deadline=None)
-    _hyp.settings.load_profile("soak")
-else:
-    _hyp.settings.load_profile("ci")
+if _hyp is not None:
+    _hyp.settings.register_profile("ci", max_examples=100, deadline=None)
+    _n = os.environ.get("CSVPLUS_HYPOTHESIS_EXAMPLES")
+    if _n:
+        _hyp.settings.register_profile("soak", max_examples=int(_n), deadline=None)
+        _hyp.settings.load_profile("soak")
+    else:
+        _hyp.settings.load_profile("ci")

@@ -1,0 +1,76 @@
+"""Hypothesis import shim: property tests degrade to clean skips when
+the ``hypothesis`` package is not installed.
+
+The differential suites are the repo's strongest correctness evidence,
+but the library is an optional dependency of the *test* environment, not
+of the package — some containers ship without it.  Importing through
+this module keeps every example-based test in the same files runnable:
+
+* with hypothesis installed, the real ``given``/``settings``/``st``
+  names are re-exported unchanged;
+* without it, ``@given(...)`` replaces the test with a zero-argument
+  function that calls ``pytest.skip`` at run time (zero-argument so
+  pytest never tries to resolve the property's parameters as fixtures),
+  and the strategy namespace returns inert chainable placeholders so
+  module-level strategy definitions still evaluate.
+"""
+
+try:
+    from hypothesis import HealthCheck, assume, example, given, settings
+    from hypothesis import strategies as st
+
+    HAVE_HYPOTHESIS = True
+except ModuleNotFoundError:  # degrade to skips, keep modules importable
+    import pytest
+
+    HAVE_HYPOTHESIS = False
+
+    class _InertStrategy:
+        """Stands in for any strategy object or strategy-returning
+        callable: every call, attribute, and combinator returns another
+        inert instance, so arbitrary ``st.lists(st.text(...)).map(f)``
+        chains evaluate at import time without hypothesis."""
+
+        def __call__(self, *args, **kwargs):
+            return self
+
+        def __getattr__(self, name):
+            return self
+
+        def __or__(self, other):
+            return self
+
+        def __ror__(self, other):
+            return self
+
+    class _StrategiesModule:
+        def __getattr__(self, name):
+            return _InertStrategy()
+
+    st = _StrategiesModule()
+
+    def given(*_args, **_kwargs):
+        def deco(f):
+            # zero-arg replacement: the property's parameters must not
+            # be visible to pytest or it would look for fixtures
+            def skipper():
+                pytest.skip("hypothesis not installed — property test skipped")
+
+            skipper.__name__ = f.__name__
+            skipper.__doc__ = f.__doc__
+            return skipper
+
+        return deco
+
+    def settings(*_args, **_kwargs):
+        return lambda f: f
+
+    def example(*_args, **_kwargs):
+        return lambda f: f
+
+    def assume(condition):
+        return bool(condition)
+
+    class HealthCheck:
+        def __getattr__(self, name):
+            return name

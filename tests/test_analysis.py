@@ -1,0 +1,458 @@
+"""Unit tests for csvplus_tpu.analysis: plan verifier rules (each fires
+on a minimal bad plan and stays silent on a good one), the AST lint, the
+verify-before-lower executor gate, and the round-6 satellite regressions
+(empty-selection crash, fused-path delimiter, ingest prefix drift)."""
+
+import os
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from csvplus_tpu import Like, Not, Row, take_rows
+from csvplus_tpu import plan as P
+from csvplus_tpu.analysis import (
+    Card,
+    ExecutorModel,
+    Presence,
+    lint_paths,
+    lint_source,
+    verify_before_lower,
+    verify_plan,
+)
+from csvplus_tpu.exprs import Rename, SetValue
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---- minimal fakes: the verifier reads only static metadata ----------
+
+
+class FakeCol:
+    def __init__(self, kind="str", has_absent=None):
+        self.kind = kind
+        if has_absent is not None:
+            self._has_absent = has_absent
+
+
+def fake_scan(columns, nrows):
+    return P.Scan(SimpleNamespace(columns=columns, nrows=nrows))
+
+
+def fake_index(columns, keys, supported=True):
+    dev = SimpleNamespace(
+        table=SimpleNamespace(columns=columns),
+        key_columns=tuple(keys),
+        supported=supported,
+    )
+    return SimpleNamespace(device_table=dev)
+
+
+PRESENT = lambda: FakeCol("str", has_absent=False)  # noqa: E731
+
+
+# ---- verifier rules --------------------------------------------------
+
+
+def test_clean_plan_is_silent():
+    scan = fake_scan({"a": PRESENT(), "b": PRESENT()}, nrows=5)
+    plan = P.SelectCols(P.Filter(scan, Like({"a": "x"})), ("a",))
+    report = verify_plan(plan)
+    assert report.diagnostics == []
+    assert report.ok and not report.predicts_empty
+    assert report.final.card is Card.MAYBE_EMPTY
+
+
+def test_resolution_select_missing_over_nonempty_warns():
+    scan = fake_scan({"b": PRESENT()}, nrows=3)
+    report = verify_plan(P.SelectCols(scan, ("a",)))
+    (diag,) = report.by_rule("resolution")
+    assert diag.severity == "warn" and '"a"' in diag.message
+    assert not report.predicts_empty  # a warning blocks the empty verdict
+
+
+def test_resolution_select_missing_over_empty_normalizes():
+    scan = fake_scan({"b": PRESENT()}, nrows=0)
+    report = verify_plan(P.SelectCols(scan, ("a",)))
+    (diag,) = report.by_rule("resolution")
+    assert diag.severity == "info"
+    assert report.predicts_empty  # both paths must yield zero rows
+    assert report.final.schema["a"].placeholder
+    assert report.final.schema["a"].presence is Presence.MAYBE
+
+
+def test_unlowerable_opaque_predicate():
+    from csvplus_tpu.columnar.exec import UnsupportedPlan
+
+    scan = fake_scan({"a": PRESENT()}, nrows=2)
+    plan = P.Filter(scan, lambda row: True)
+    report = verify_plan(plan)
+    assert [d.rule for d in report.errors] == ["unlowerable"]
+    with pytest.raises(UnsupportedPlan):
+        verify_before_lower(plan)
+
+
+def test_unlowerable_validate_mid_chain(monkeypatch):
+    from csvplus_tpu.columnar.exec import UnsupportedPlan
+
+    scan = fake_scan({"a": PRESENT()}, nrows=2)
+    mid = P.Top(P.Validate(scan, Like({"a": "x"}), "bad"), 1)
+    assert verify_plan(mid).by_rule("unlowerable")
+    with pytest.raises(UnsupportedPlan):
+        verify_before_lower(mid)
+    # terminal Validate is lowerable
+    last = P.Validate(P.Top(scan, 1), Like({"a": "x"}), "bad")
+    assert not verify_plan(last).by_rule("unlowerable")
+    # the escape hatch bypasses verification entirely
+    monkeypatch.setenv("CSVPLUS_VERIFY", "0")
+    assert verify_before_lower(mid) is None
+
+
+def test_lane_flow_typed_key_probing_dict_index():
+    scan = fake_scan({"k": FakeCol("int"), "p": PRESENT()}, nrows=4)
+    idx = fake_index({"k": PRESENT(), "v": PRESENT()}, ("k",))
+    report = verify_plan(P.Join(scan, idx, ("k",)))
+    assert any(
+        d.rule == "lane-flow" and d.severity == "warn"
+        for d in report.diagnostics
+    )
+    # same join over a dictionary stream key: no lane-flow diagnostic
+    scan2 = fake_scan({"k": PRESENT(), "p": PRESENT()}, nrows=4)
+    report2 = verify_plan(P.Join(scan2, idx, ("k",)))
+    assert not report2.by_rule("lane-flow")
+
+
+def test_lane_flow_rename_merge_across_lanes():
+    scan = fake_scan(
+        {"s": FakeCol("str", has_absent=True), "i": FakeCol("int")}, nrows=4
+    )
+    report = verify_plan(P.MapExpr(scan, Rename({"s": "i"})))
+    (diag,) = report.by_rule("lane-flow")
+    assert diag.severity == "warn" and "demotion" in diag.message
+
+
+def test_lane_flow_setvalue_over_typed_lane():
+    scan = fake_scan({"i": FakeCol("int")}, nrows=4)
+    report = verify_plan(P.MapExpr(scan, SetValue("i", "k")))
+    (diag,) = report.by_rule("lane-flow")
+    assert diag.severity == "info"
+
+
+ROUND5_ROWS = [Row({"b": ""})]
+
+
+def round5_plan(scan):
+    """filter(missing) -> select(missing) -> filter(placeholder): the
+    exact plan shape hypothesis minimized in round 5."""
+    f1 = P.Filter(scan, Like({"a": "x"}))
+    sel = P.SelectCols(f1, ("a",))
+    return P.Filter(sel, Like({"a": "x"}))
+
+
+def test_empty_relation_round5_plan_against_executor_models():
+    plan = round5_plan(fake_scan({"b": PRESENT()}, nrows=1))
+    fixed = verify_plan(plan)
+    # current executor: statically normalized to the empty result
+    assert not fixed.errors
+    assert fixed.predicts_empty
+    assert any(d.rule == "empty-relation" for d in fixed.diagnostics)
+    # pin the PRE-fix executor: the verifier reports the historical
+    # device crash (empty selection pad gathering a 0-length placeholder)
+    broken = verify_plan(plan, ExecutorModel(empty_selection_masks=False))
+    (err,) = broken.errors
+    assert err.rule == "empty-relation" and "placeholder" in err.message
+
+
+def test_filter_constant_false_proves_empty():
+    scan = fake_scan({"b": PRESENT()}, nrows=9)
+    report = verify_plan(P.Filter(scan, Like({"missing": "x"})))
+    assert report.final.card is Card.EMPTY
+    assert report.predicts_empty
+    # Not(missing) is constant TRUE: keeps NONEMPTY
+    report2 = verify_plan(P.Filter(scan, Not(Like({"missing": "x"}))))
+    assert report2.final.card is Card.NONEMPTY
+
+
+def test_top_zero_proves_empty():
+    scan = fake_scan({"b": PRESENT()}, nrows=9)
+    assert verify_plan(P.Top(scan, 0)).predicts_empty
+    assert verify_plan(P.Top(scan, 3)).final.card is Card.NONEMPTY
+
+
+def test_divergence_risk_chain_depth_and_stage_coverage():
+    scan = fake_scan({"a": PRESENT()}, nrows=5)
+    plan = scan
+    for _ in range(5):
+        plan = P.Filter(plan, Like({"a": "x"}))
+    msgs = [d.message for d in verify_plan(plan).by_rule("divergence-risk")]
+    assert any("exceeds the random differential vocabulary" in m for m in msgs)
+    # Join is outside the random stage vocabulary
+    idx = fake_index({"a": PRESENT()}, ("a",))
+    join = P.Join(fake_scan({"a": PRESENT()}, 5), idx, ("a",))
+    msgs = [d.message for d in verify_plan(join).by_rule("divergence-risk")]
+    assert any("stage Join has no random differential coverage" in m for m in msgs)
+    # short covered chains carry no divergence notes
+    short = P.Top(P.Filter(scan, Like({"a": "x"})), 2)
+    assert not verify_plan(short).by_rule("divergence-risk")
+
+
+def test_verifier_publishes_telemetry_counters():
+    from csvplus_tpu.utils.observe import telemetry
+
+    plan = P.SelectCols(fake_scan({"b": PRESENT()}, 3), ("a",))
+    with telemetry.collect():
+        verify_plan(plan)
+        assert telemetry.counters["verify.plans"] == 1
+        assert telemetry.counters["verify.resolution.warn"] == 1
+
+
+# ---- the re-enabled round-5 differential regression ------------------
+
+
+def _run(src):
+    from csvplus_tpu import DataSourceError
+
+    try:
+        return ("rows", src.to_rows())
+    except DataSourceError as e:
+        return ("error", str(e))
+
+
+def test_round5_missing_column_regression():
+    """HEAD-RED in round 5: host returned [] while the device executor
+    crashed (non-empty jnp.take from an empty placeholder axis).  Both
+    paths must now return [] — and the verifier must predict it."""
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    pipe = (
+        lambda s: s.filter(Like({"a": "x"}))
+        .select_columns("a")
+        .filter(Like({"a": "x"}))
+    )
+    host = _run(pipe(take_rows([Row(r) for r in ({"b": ""},)])))
+    dev_src = pipe(
+        source_from_table(DeviceTable.from_rows(ROUND5_ROWS, device="cpu"))
+    )
+    assert verify_plan(dev_src.plan).predicts_empty
+    dev = _run(dev_src)
+    assert host == dev == ("rows", [])
+
+
+def test_round5_regression_survives_verify_off(monkeypatch):
+    """The executor fix stands on its own: same plan, verifier disabled."""
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    monkeypatch.setenv("CSVPLUS_VERIFY", "0")
+    dev = _run(
+        source_from_table(DeviceTable.from_rows(ROUND5_ROWS, device="cpu"))
+        .filter(Like({"a": "x"}))
+        .select_columns("a")
+        .filter(Like({"a": "x"}))
+    )
+    assert dev == ("rows", [])
+
+
+# ---- AST lint --------------------------------------------------------
+
+
+CTYPES_BAD = """
+import ctypes
+
+def setup(lib):
+    lib.f.argtypes = [ctypes.c_void_p, ctypes.c_char]
+
+def call(lib, d):
+    lib.f(0, d.encode("utf-8"))
+"""
+
+CTYPES_GUARDED = """
+import ctypes
+
+def setup(lib):
+    lib.f.argtypes = [ctypes.c_void_p, ctypes.c_char]
+
+def call(lib, d):
+    if len(d.encode("utf-8")) != 1:
+        raise ValueError(d)
+    lib.f(0, d.encode("utf-8"))
+
+def call_via_local(lib, d):
+    db = d.encode("utf-8")
+    if len(db) == 1:
+        lib.f(0, db)
+
+def call_sliced(lib, d):
+    lib.f(0, (d or "x").encode("utf-8")[0:1])
+"""
+
+CTYPES_SUPPRESSED = """
+import ctypes
+
+def setup(lib):
+    lib.f.argtypes = [ctypes.c_void_p, ctypes.c_char]
+
+def call(lib, d):
+    lib.f(0, d.encode("utf-8"))  # analysis: allow[CTYPES001]
+"""
+
+JIT_BAD = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def k(cks):
+    return jnp.concatenate([c.astype(jnp.int32) for c in cks])
+"""
+
+JIT_OK = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def k(x):
+    return sum(x[i] for i in range(3))
+
+def not_jitted(cks):
+    return jnp.concatenate([c for c in cks])
+"""
+
+JIT_SUPPRESSED = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def k(cks):  # analysis: allow[JIT001]
+    return jnp.concatenate([c for c in cks])
+"""
+
+
+def test_astlint_ctypes_fires_on_ungated_encode():
+    (f,) = lint_source(CTYPES_BAD)
+    assert f.code == "CTYPES001" and "c_char parameter 1" in f.message
+
+
+def test_astlint_ctypes_silent_when_gated():
+    assert lint_source(CTYPES_GUARDED) == []
+
+
+def test_astlint_ctypes_suppression_comment():
+    assert lint_source(CTYPES_SUPPRESSED) == []
+
+
+def test_astlint_jit_fires_on_param_comprehension():
+    (f,) = lint_source(JIT_BAD)
+    assert f.code == "JIT001" and "`cks`" in f.message
+
+
+def test_astlint_jit_silent_on_nonparam_iteration():
+    assert lint_source(JIT_OK) == []
+
+
+def test_astlint_jit_suppression_on_def_line():
+    assert lint_source(JIT_SUPPRESSED) == []
+
+
+def test_repo_tree_is_lint_clean():
+    """The `make lint` AST pass over the real package must be silent —
+    outstanding findings are fixed or explicitly acknowledged in code."""
+    assert lint_paths([REPO / "csvplus_tpu"]) == []
+
+
+# ---- satellite: fused-path delimiter gate ----------------------------
+
+
+def test_fused_parse_rejects_multibyte_delimiter():
+    native = pytest.importorskip("csvplus_tpu.native.scanner")
+    data = b"1,2\n3,4\n"
+    header = {"a": 0, "b": 1}
+    typed_state = {"a": (b"",), "b": (b"",)}
+    try:
+        ok = native.scan_parse_i32_native(data, ",", 2, header, typed_state)
+    except ImportError:
+        pytest.skip("native library unavailable")
+    if ok is None:
+        pytest.skip("native library unavailable")
+    nrec, cols = ok
+    assert nrec == 2
+    assert cols["a"][2].tolist() == [1, 3]
+    # multi-byte delimiters bail to the generic scan instead of letting
+    # ctypes choke on a 2-byte c_char (round-5 ADVICE finding)
+    assert (
+        native.scan_parse_i32_native(
+            data.replace(b",", b"::"), "::", 2, header, typed_state
+        )
+        is None
+    )
+    assert (
+        native.scan_parse_i32_native(data, "é", 2, header, typed_state)
+        is None
+    )
+
+
+def test_scan_bytes_rejects_multibyte_delimiter():
+    native = pytest.importorskip("csvplus_tpu.native.scanner")
+    try:
+        native.scan_bytes(b"a,b\n", delimiter=",")
+    except ImportError:
+        pytest.skip("native library unavailable")
+    with pytest.raises(ValueError, match="1-byte delimiter"):
+        native.scan_bytes(b"a::b\n", delimiter="::")
+
+
+# ---- satellite: ingest typed-prefix drift ----------------------------
+
+
+def _stream_table(monkeypatch, chunks):
+    """Drive _stream_to_table over a synthetic encoded-chunk stream."""
+    from csvplus_tpu.columnar import ingest
+    from csvplus_tpu.native import scanner
+
+    monkeypatch.setattr(
+        scanner,
+        "stream_encoded_chunks",
+        lambda reader, path, encoder=None: iter(chunks),
+    )
+    return ingest._stream_to_table(None, "unused.csv", "cpu")
+
+
+def _cells(table, col):
+    return [r[col] for r in table.to_rows()]
+
+
+def test_ingest_prefix_drift_demotes_not_overwrites(monkeypatch):
+    """Round-5 ADVICE: a typed chunk whose affix prefix differs from the
+    established one must demote the column, not overwrite int_prefix —
+    the overwrite reinterpreted every earlier chunk's values."""
+    chunks = [
+        (["v"], {"v": ("int", b"o", np.array([1, 2], dtype=np.int32))}, 2),
+        (["v"], {"v": ("int", b"c", np.array([3], dtype=np.int32))}, 1),
+    ]
+    table = _stream_table(monkeypatch, chunks)
+    assert _cells(table, "v") == ["o1", "o2", "c3"]
+
+
+def test_ingest_demoted_column_never_reenters_typed_mode(monkeypatch):
+    """Once demoted, later conforming typed chunks must stay on the
+    dictionary path — finalize's IntColumn branch would silently drop
+    the dictionary chunks accumulated in between."""
+    d1 = np.array([b"x"], dtype="S1")
+    chunks = [
+        (["v"], {"v": ("int", b"o", np.array([1], dtype=np.int32))}, 1),
+        (["v"], {"v": (d1, np.array([0], dtype=np.int32))}, 1),
+        (["v"], {"v": ("int", b"o", np.array([2], dtype=np.int32))}, 1),
+    ]
+    table = _stream_table(monkeypatch, chunks)
+    assert _cells(table, "v") == ["o1", "x", "o2"]
+
+
+def test_ingest_pure_typed_column_still_finalizes_as_int(monkeypatch):
+    chunks = [
+        (["v"], {"v": ("int", b"o", np.array([1, 2], dtype=np.int32))}, 2),
+        (["v"], {"v": ("int", b"o", np.array([3], dtype=np.int32))}, 1),
+    ]
+    table = _stream_table(monkeypatch, chunks)
+    assert table.columns["v"].kind == "int"
+    assert _cells(table, "v") == ["o1", "o2", "o3"]

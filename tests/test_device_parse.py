@@ -152,8 +152,8 @@ def test_ondevice_pipeline_through_device_parse(people_csv, monkeypatch):
     assert Take(idx).to_rows() == Take(host.index_on("surname", "name")).to_rows()
 
 
-from hypothesis import given
-from hypothesis import strategies as st
+from hypo_compat import given
+from hypo_compat import st
 
 _simple_field = st.text(
     alphabet=st.characters(

@@ -4,8 +4,8 @@
 import io
 
 import pytest
-from hypothesis import given
-from hypothesis import strategies as st
+from hypo_compat import given
+from hypo_compat import st
 
 from csvplus_tpu import DataSourceError, Take, from_file
 from csvplus_tpu.csvio import CsvParseError, parse_records

@@ -175,6 +175,7 @@ def test_value_as_int_int64_range():
 def test_value_as_float_property_vs_python():
     """Property: on plain decimal literals (the common case) the Go
     grammar agrees with Python's float() after underscore stripping."""
+    pytest.importorskip("hypothesis")
     from hypothesis import given, strategies as st
     from csvplus_tpu.row import parse_go_float
 

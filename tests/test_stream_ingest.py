@@ -218,8 +218,8 @@ def test_stream_comment_only_first_chunk(tmp_path):
     assert cols == mk().read_columns()[1]
 
 
-from hypothesis import given, settings
-from hypothesis import strategies as st
+from hypo_compat import given, settings
+from hypo_compat import st
 
 _cell = st.text(
     alphabet=st.characters(

@@ -354,7 +354,7 @@ def test_typed_except_and_select(joined_files):
     assert _dicts(a) == _dicts(b)
 
 
-from hypothesis import given, settings, strategies as st
+from hypo_compat import given, settings, st
 
 _PREFIXES = ["", "o", "c", "id-", "a,b", "00", "-", "é", " p"]
 # poisons exercise DISTINCT demotion branches: non-digit bail, int32
