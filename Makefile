@@ -1,7 +1,7 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench dryrun example coldcheck lint
+.PHONY: test soak bench bench-micro dryrun example coldcheck lint
 
 test:
 	python -m pytest tests/ -x -q
@@ -25,6 +25,12 @@ soak:
 
 bench:
 	python bench.py
+
+# Seconds-long CPU smoke of the batched point-lookup engine: one JSON
+# line with batched find_many lookups/s on the 1M-row big-index shape;
+# exits nonzero on a >2x regression vs bench_micro_floor.json.
+bench-micro:
+	JAX_PLATFORMS=cpu python bench.py --micro-lookup
 
 dryrun:
 	python __graft_entry__.py

@@ -91,6 +91,7 @@ class _Recorder:
         self._lock = threading.Lock()
         self._record: "dict | None" = None
         self._floor: "dict | None" = None
+        self._printed_record: "dict | None" = None
         self.printed = False
 
     def register(self, record: dict) -> None:
@@ -131,7 +132,16 @@ class _Recorder:
                     ),
                 )
             print(json.dumps(record), flush=True)
+            self._printed_record = record
             self.printed = True
+
+    def reprint_last(self) -> None:
+        """Echo the already-printed record again, so it is the TRUE last
+        stdout line (the driver parses the last line; anything the
+        informational tiers may have leaked to stdout must not be it)."""
+        with self._lock:
+            if self._printed_record is not None:
+                print(json.dumps(self._printed_record), flush=True)
 
 
 _recorder = _Recorder()
@@ -630,9 +640,12 @@ def _orchestrate() -> None:
         " concurrent with the net diagnostic + CPU floor child)\n"
     )
     diag = _net_diagnostic()
+    # diagnostics go to STDERR, never onto the headline record: the
+    # driver parses the final stdout line and bulky nested payloads
+    # have broken that parse before (round-5 weak #2)
+    sys.stderr.write(f"bench: net_diag: {_json.dumps(diag)}\n")
     floor = _run_cpu_child()
     if floor is not None:
-        floor["net_diag"] = diag
         _recorder.register(floor)
         sys.stderr.write(
             f"bench: CPU floor recorded ({floor.get('value', 0):,.0f} rows/s);"
@@ -715,10 +728,12 @@ def _orchestrate() -> None:
         "vs_baseline": 0.0,
         "backend": "none",
     }
-    record["probe_error"] = last_err[-900:]
+    # full diagnostics to stderr; the record keeps only a compact note
+    # so the final stdout line stays parseable (round-5 weak #2)
+    sys.stderr.write(f"bench: probe_error: {last_err[-900:]}\n")
     if reprobe_err.strip():
-        record["reprobe_error"] = reprobe_err[-600:]
-    record["net_diag"] = diag
+        sys.stderr.write(f"bench: reprobe_error: {reprobe_err[-600:]}\n")
+    sys.stderr.write(f"bench: net_diag: {_json.dumps(diag)}\n")
     record["note"] = (
         "accelerator unreachable for the whole budget; CPU floor record."
         f" network diagnosis: {diag.get('summary', 'n/a')}"
@@ -805,7 +820,10 @@ def main() -> None:
         "link_rtt_ms": round(rtt, 1),
     }
     if net_diag is not None:
-        record["net_diag"] = net_diag
+        import json as _json
+
+        # stderr only: the nested diagnostic must never ride the record
+        sys.stderr.write(f"bench: net_diag: {_json.dumps(net_diag)}\n")
     if go_rps:
         record["go_class_proxy_rows_per_sec"] = round(go_rps, 1)
         record["vs_go_class_proxy"] = round(dev_rps / go_rps, 2)
@@ -851,6 +869,10 @@ def main() -> None:
         # daemon thread still holds it); later tiers would only measure
         # contention or block for their full deadline — skip them
         sys.stderr.write("bench: remaining tiers skipped after an abandoned tier\n")
+    # the compact record again as the TRUE last stdout line: the driver
+    # parses the last line, and the tiers above must not be able to
+    # leave anything after it
+    _recorder.reprint_last()
     os._exit(0)  # never hang in backend teardown
 
 
@@ -966,6 +988,12 @@ def _micro_benchmarks() -> None:
         t_find_big = rate(
             lambda: [big_idx.find(str(i)).to_rows() for i in range(120)]
         )
+        # batched columns: the same probe sets through find_many
+        from csvplus_tpu import to_rows_many
+
+        small_probes = [str(i) for i in range(120)]
+        t_fm_small = rate(lambda: to_rows_many(small_idx.find_many(small_probes)))
+        t_fm_big = rate(lambda: to_rows_many(big_idx.find_many(small_probes)))
         t_join_fwd = rate(
             lambda: take_rows(orders).join(small_idx, "cust_id").to_rows()
         )
@@ -978,12 +1006,89 @@ def _micro_benchmarks() -> None:
             f"{120 / t_small:,.0f} rows/s | index build 10k multi "
             f"{10_000 / t_big:,.0f} rows/s | find small "
             f"{120 / t_find_small:,.0f} lookups/s | find big "
-            f"{120 / t_find_big:,.0f} lookups/s | join 10k>120 "
+            f"{120 / t_find_big:,.0f} lookups/s | find_many small "
+            f"{120 / t_fm_small:,.0f} lookups/s | find_many big "
+            f"{120 / t_fm_big:,.0f} lookups/s | join 10k>120 "
             f"{10_000 / t_join_fwd:,.0f} rows/s | join 120>10k "
             f"{120 / t_join_rev:,.0f} probe rows/s\n"
         )
     except Exception as e:
         sys.stderr.write(f"bench[micro] skipped: {e}\n")
+
+
+def _micro_lookup() -> int:
+    """The `make bench-micro` smoke tier: CPU-only, seconds, hermetic.
+
+    Builds the 1M-row big-index micro shape (CSVPLUS_MICRO_ROWS to
+    shrink), measures batched ``find_many`` vs looped single ``find``
+    lookups/s, prints ONE JSON line, and exits nonzero when the batched
+    rate regresses more than 2x below the checked-in floor
+    (bench_micro_floor.json).  Parity between the two paths is asserted
+    as part of the smoke."""
+    import numpy as np
+
+    import csvplus_tpu as cp
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    n = int(os.environ.get("CSVPLUS_MICRO_ROWS", 1_000_000))
+    n_probes = int(os.environ.get("CSVPLUS_MICRO_PROBES", 10_000))
+    ids = np.arange(n, dtype=np.int64) * 7 % (n * 3)
+    keys = np.char.add("c", ids.astype(np.str_))
+    t = DeviceTable.from_pylists(
+        {"cust_id": keys.tolist(), "v": np.arange(n).astype(np.str_).tolist()},
+        device="cpu",
+    )
+    idx = cp.take(t).index_on("cust_id").sync()
+    rng = np.random.default_rng(0)
+    probes = [f"c{int(v)}" for v in rng.choice(ids, n_probes)]
+    _ = cp.to_rows_many(idx.find_many(probes[:10]))  # warm mirror + dispatch
+    # best-of-3 with the decoded-block LRU dropped between passes: every
+    # pass pays the full vectorized search + gather-decode, so the best
+    # pass measures the engine, not the cache (or scheduler noise)
+    mirror = idx._impl.dev.table
+    t_batch = float("inf")
+    for _rep in range(3):
+        mirror._mirror_lru = None
+        t0 = time.perf_counter()
+        groups = cp.to_rows_many(idx.find_many(probes))
+        t_batch = min(t_batch, time.perf_counter() - t0)
+    n_single = min(1000, n_probes)
+    t0 = time.perf_counter()
+    singles = [idx.find(p).to_rows() for p in probes[:n_single]]
+    t_single = time.perf_counter() - t0
+    assert groups[:n_single] == singles, "find_many != looped find"
+    record = {
+        "metric": "big_index_lookups_per_sec_batched",
+        "value": round(n_probes / t_batch, 1),
+        "unit": "lookups/s",
+        "single_find_lookups_per_sec": round(n_single / t_single, 1),
+        "n_rows": n,
+        "n_probes": n_probes,
+    }
+    print(json.dumps(record), flush=True)
+    floor_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_micro_floor.json"
+    )
+    floor = 0.0
+    try:
+        with open(floor_path) as f:
+            floor = float(
+                json.load(f).get("big_index_lookups_per_sec_batched", 0.0)
+            )
+    except (OSError, ValueError):
+        pass
+    if floor and record["value"] < floor / 2:
+        sys.stderr.write(
+            f"bench[micro-lookup] REGRESSION: batched {record['value']:,.0f}"
+            f" lookups/s is under half the floor ({floor:,.0f})\n"
+        )
+        return 1
+    sys.stderr.write(
+        f"bench[micro-lookup] ok: batched {record['value']:,.0f} lookups/s"
+        f" (floor {floor:,.0f}) | single {record['single_find_lookups_per_sec']:,.0f}"
+        f" lookups/s (n={n})\n"
+    )
+    return 0
 
 
 def _secondary_metrics(n_orders: int) -> None:
@@ -1043,6 +1148,17 @@ def _secondary_metrics(n_orders: int) -> None:
             hits = sum(len(idx.find(p).to_rows()) > 0 for p in probes)
             t_find = time.perf_counter() - t0
             assert hits == len(probes) and warm_hits == 10
+            # the batched column on the SAME 1M-row big-index shape:
+            # one vectorized bounds pass + one amortized decode for 10K
+            # probes (the find_many engine's headline tier)
+            from csvplus_tpu import to_rows_many
+
+            many = min(10_000, n)
+            many_probes = [f"c{int(v)}" for v in ids[:many]]
+            t0 = time.perf_counter()
+            groups = to_rows_many(idx.find_many(many_probes))
+            t_find_many = time.perf_counter() - t0
+            assert sum(1 for g in groups if g) == many
             t0 = time.perf_counter()
             idx.resolve_duplicates("first")
             _ = len(idx)
@@ -1052,6 +1168,8 @@ def _secondary_metrics(n_orders: int) -> None:
             f"index build {n / t_index:,.0f} rows/s | "
             f"device find {lookups / t_find:,.0f} lookups/s "
             f"(one-time mirror {t_mirror * 1000:,.0f}ms) | "
+            f"device find_many {many / t_find_many:,.0f} lookups/s "
+            f"({many} probes batched) | "
             f"policy dedup {n / t_dedup:,.0f} rows/s (n={n})\n"
         )
     except Exception as e:  # secondary metrics must never break the line
@@ -1059,4 +1177,8 @@ def _secondary_metrics(n_orders: int) -> None:
 
 
 if __name__ == "__main__":
+    if "--micro-lookup" in sys.argv:
+        # hermetic CPU smoke tier: set the platform before jax loads
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.exit(_micro_lookup())
     main()

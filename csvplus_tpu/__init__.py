@@ -38,6 +38,7 @@ from .row import (
 from .source import DataSource, RowFunc, take, take_rows
 from .reader import Reader, from_file, from_read_closer, from_reader
 from .index import Index, create_index, create_unique_index, load_index
+from .sinks import to_rows_many
 from .predicates import All, Any_, Like, Not, Predicate
 from .exprs import Rename, SetValue, Update
 from . import plan
@@ -50,6 +51,7 @@ FromFile = from_file
 FromReader = from_reader
 FromReadCloser = from_read_closer
 LoadIndex = load_index
+ToRowsMany = to_rows_many
 Any = Any_  # Go's csvplus.Any; shadows builtins.any only inside this module
 
 __all__ = [
@@ -74,6 +76,7 @@ __all__ = [
     "load_index",
     "create_index",
     "create_unique_index",
+    "to_rows_many",
     # predicates & symbolic exprs
     "Predicate",
     "All",
@@ -96,6 +99,7 @@ __all__ = [
     "FromReader",
     "FromReadCloser",
     "LoadIndex",
+    "ToRowsMany",
 ]
 
 __version__ = "0.1.0"

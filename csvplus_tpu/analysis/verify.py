@@ -73,7 +73,19 @@ __all__ = [
 # (tests/test_differential.py ``stages()``): anything outside it gets a
 # divergence-risk note.
 DIFF_COVERED_STAGES = frozenset(
-    ["Filter", "SelectCols", "DropCols", "Top", "DropRows", "MapExpr", "TakeWhile", "DropWhile"]
+    [
+        "Filter",
+        "SelectCols",
+        "DropCols",
+        "Top",
+        "DropRows",
+        "MapExpr",
+        "TakeWhile",
+        "DropWhile",
+        "Join",
+        "Except",
+        "Validate",
+    ]
 )
 DIFF_MAX_STAGES = 4
 
@@ -261,14 +273,9 @@ class _Verifier:
             else:
                 if info.placeholder:
                     self._check_empty_gather(state, c, what)
-                if info.lane == "int":
-                    self.diag(
-                        "divergence-risk",
-                        "info",
-                        f'typed int32 lane "{c}" under a {what} predicate — '
-                        "typed lanes are not mixed into the random differential "
-                        "generator (fixed-shape coverage only)",
-                    )
+                # typed int32 lanes under predicates are inside the random
+                # differential envelope since the typed-ingest generator
+                # (tests/test_differential.py) — no divergence note
         return cols
 
     def _check_empty_gather(self, state: NodeState, name: str, what: str) -> None:
@@ -315,6 +322,19 @@ class _Verifier:
                     "(host push semantics upstream of other stages)",
                 )
             self._check_pred(state, node.pred, "Validate")
+            # unless the predicate is statically TRUE (or no row can
+            # reach it), a clean report does NOT imply the run succeeds:
+            # validation aborts are data-dependent by design
+            if (
+                state.card is not Card.EMPTY
+                and _pred_truth(node.pred, state) is not _Truth.TRUE
+            ):
+                self.diag(
+                    "data-dependent",
+                    "info",
+                    "Validate may abort the pipeline on any failing row "
+                    "(identical error on both executors)",
+                )
             return state
 
         if isinstance(node, (P.TakeWhile, P.DropWhile)):
@@ -507,8 +527,14 @@ class _Verifier:
     def run(self, root: P.PlanNode) -> PlanReport:
         chain = P.linearize(root)
         scan = chain[0]
-        assert isinstance(scan, P.Scan)
+        assert isinstance(scan, (P.Scan, P.Lookup))
         state = scan_state(scan.table)
+        if isinstance(scan, P.Lookup):
+            # the leaf is a statically-known [lower, upper) row range of
+            # the index table: its cardinality is exact, not the table's
+            state = state.with_card(
+                Card.NONEMPTY if scan.upper > scan.lower else Card.EMPTY
+            )
         self.report.states.append(state)
         n_stages = len(chain) - 1
         for pos, node in enumerate(chain[1:], start=1):

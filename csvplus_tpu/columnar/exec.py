@@ -155,7 +155,7 @@ def execute_plan_view(root: P.PlanNode) -> "_View":
         if isinstance(node, P.Validate):
             raise UnsupportedPlan("Validate is device-lowered only as last stage")
     scan = stages[0]
-    assert isinstance(scan, P.Scan)
+    assert isinstance(scan, (P.Scan, P.Lookup))
     table: DeviceTable = scan.table
     # full_len follows the stored column length, which may exceed nrows
     # when codes are padded for mesh-sharding divisibility; the selection
@@ -165,17 +165,36 @@ def execute_plan_view(root: P.PlanNode) -> "_View":
     )
     import jax.numpy as jnp
 
-    view = _View(
-        dict(table.columns),
-        jnp.arange(table.nrows, dtype=jnp.int32),
-        table.device,
-        stored_len,
-        scan_base=getattr(table, "row_base", 0),
-        # identity shortcut only for unpadded tables: padded (mesh-
-        # sharded) columns must be gathered down to nrows before any
-        # consumer sees them
-        identity=stored_len == table.nrows,
-    )
+    if isinstance(scan, P.Lookup):
+        # a Scan restricted to a statically-known contiguous row range:
+        # the selection starts as arange(lower, upper) over the index's
+        # sorted table; every downstream stage lowers unchanged
+        view = _View(
+            dict(table.columns),
+            jnp.arange(scan.lower, scan.upper, dtype=jnp.int32),
+            table.device,
+            stored_len,
+            # host parity: streaming a find result numbers rows 0-based
+            # within the matched slice, so shift the base by -lower
+            scan_base=getattr(table, "row_base", 0) - scan.lower,
+            identity=(
+                scan.lower == 0
+                and scan.upper == table.nrows
+                and stored_len == table.nrows
+            ),
+        )
+    else:
+        view = _View(
+            dict(table.columns),
+            jnp.arange(table.nrows, dtype=jnp.int32),
+            table.device,
+            stored_len,
+            scan_base=getattr(table, "row_base", 0),
+            # identity shortcut only for unpadded tables: padded (mesh-
+            # sharded) columns must be gathered down to nrows before any
+            # consumer sees them
+            identity=stored_len == table.nrows,
+        )
 
     from ..utils.observe import telemetry
 

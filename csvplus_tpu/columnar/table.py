@@ -20,8 +20,9 @@ strings are only materialized back on the host at the sink boundary.
 
 from __future__ import annotations
 
+import os
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -323,6 +324,55 @@ class StringColumn:
         q = pack_host(np.array([key], dtype="S"), n_lanes)
         qs = tuple(jnp.asarray(l) for l in q)
         return int(translate_lanes(self.dev_dictionary, qs)[0])
+
+    def find_codes(self, values: Sequence[str]) -> np.ndarray:
+        """Vectorized :meth:`find_code` over a batch of probe values —
+        int64 codes, -1 where the value is not in the dictionary.
+
+        One ``np.searchsorted`` over the host dictionary (or ONE jitted
+        lane translation for device-lane dictionaries), instead of a
+        binary search + device dispatch per probe: the per-column half
+        of the batched lookup engine (``DeviceIndex.point_bounds_many``).
+        """
+        m = len(values)
+        if m == 0:
+            return np.empty(0, dtype=np.int64)
+        if self._dictionary is not None:
+            d = self._dictionary
+            if d.size == 0:
+                return np.full(m, -1, dtype=np.int64)
+            if d.dtype.kind == "S":
+                enc = np.array([v.encode("utf-8") for v in values], dtype="S")
+            else:
+                enc = np.asarray(values, dtype=d.dtype)
+            pos = np.searchsorted(d, enc)
+            pos_c = np.clip(pos, 0, d.size - 1)
+            ok = d[pos_c] == enc
+            return np.where(ok, pos_c, -1).astype(np.int64)
+        from ..ops.lanes import (
+            MAX_LANE_BYTES,
+            lanes_for_width,
+            pack_host,
+            translate_lanes,
+        )
+
+        self._ensure_sorted_lanes()  # the lane search needs sorted order
+        n_lanes = len(self.dev_dictionary)
+        out = np.full(m, -1, dtype=np.int64)
+        keys = [v.encode("utf-8") for v in values]
+        # values wider than any stored entry can never match; translate
+        # only the rest, in ONE fused device search over all of them
+        fit = [
+            i
+            for i, k in enumerate(keys)
+            if len(k) <= MAX_LANE_BYTES and lanes_for_width(len(k)) <= n_lanes
+        ]
+        if fit:
+            sub = np.array([keys[i] for i in fit], dtype="S")
+            q = pack_host(sub, n_lanes)
+            qs = tuple(jnp.asarray(l) for l in q)
+            out[fit] = np.asarray(translate_lanes(self.dev_dictionary, qs))
+        return out
 
     @property
     def has_absent(self) -> bool:
@@ -789,16 +839,89 @@ class DeviceTable:
         The device-lazy Index's point-lookup decode: each column's codes
         mirror to host once (StringColumn.codes_host), then every find
         is pure numpy — no device dispatch at all."""
-        out = [Row() for _ in range(upper - lower)]
-        for name, col in self.columns.items():
-            if col.kind == "int":
-                vals = col.decode_slice(lower, upper)  # host format, no demote
+        return self.rows_from_mirror_many([(lower, upper)])[0]
+
+    # Decoded mirror blocks are cached per (lower, upper) range up to this
+    # many rows; repeated probes of hot keys then skip the decode entirely.
+    # Checked per call so tests can tune it via the environment.
+    MIRROR_LRU_ROWS_DEFAULT = 65536
+
+    def _mirror_lru_cap(self) -> int:
+        return int(
+            os.environ.get(
+                "CSVPLUS_MIRROR_LRU_ROWS", str(self.MIRROR_LRU_ROWS_DEFAULT)
+            )
+        )
+
+    def rows_from_mirror_many(
+        self, bounds: Sequence[Tuple[int, int]]
+    ) -> List[List[Row]]:
+        """Batched :meth:`rows_from_mirror`: ONE gather + decode per
+        column over the union of all requested ranges, split back into
+        per-range row blocks, with a bounded LRU over decoded blocks.
+
+        Returned blocks share Row objects with the cache (and across
+        duplicate ranges) — the same sharing contract as the host tier's
+        ``rows[lower:upper]`` slices; ``iterate`` clones on delivery.
+        """
+        lru = getattr(self, "_mirror_lru", None)
+        if lru is None:
+            from collections import OrderedDict
+
+            lru = self._mirror_lru = OrderedDict()
+            self._mirror_lru_rows = 0
+        out: List[Optional[List[Row]]] = [None] * len(bounds)
+        misses: Dict[Tuple[int, int], List[int]] = {}
+        for i, (lo, hi) in enumerate(bounds):
+            lo, hi = int(lo), int(hi)
+            if hi <= lo:
+                out[i] = []
+                continue
+            got = lru.get((lo, hi))
+            if got is not None:
+                lru.move_to_end((lo, hi))
+                out[i] = got
             else:
-                vals = col.decode_codes(col.codes_host()[lower:upper])
-            for i, v in enumerate(vals):
-                if v is not None:
-                    out[i][name] = v
-        return out
+                misses.setdefault((lo, hi), []).append(i)
+        if misses:
+            ranges = list(misses)
+            starts = np.array([r[0] for r in ranges], dtype=np.int64)
+            sizes = np.array([r[1] - r[0] for r in ranges], dtype=np.int64)
+            # vectorized concat of aranges: arange(total) re-based per
+            # range (an arange + concatenate per range is pure overhead
+            # when most matches are single rows)
+            offsets = np.concatenate(([0], np.cumsum(sizes)[:-1]))
+            idx = (
+                np.arange(int(sizes.sum()), dtype=np.int64)
+                + np.repeat(starts - offsets, sizes)
+            )
+            decoded = {}
+            for name, col in self.columns.items():
+                if col.kind == "int":
+                    decoded[name] = col.decode_take(idx)
+                else:
+                    decoded[name] = col.decode_codes(col.codes_host()[idx])
+            names = list(decoded)
+            off = 0
+            for r in ranges:
+                size = r[1] - r[0]
+                block = [Row() for _ in range(size)]
+                for name in names:
+                    vals = decoded[name]
+                    for j in range(size):
+                        v = vals[off + j]
+                        if v is not None:
+                            block[j][name] = v
+                off += size
+                for i in misses[r]:
+                    out[i] = block
+                lru[r] = block
+                self._mirror_lru_rows += size
+            cap = self._mirror_lru_cap()
+            while self._mirror_lru_rows > cap and len(lru) > 1:
+                _, evicted = lru.popitem(last=False)
+                self._mirror_lru_rows -= len(evicted)
+        return out  # type: ignore[return-value]
 
     # -- iteration protocol so take(DeviceTable) works ---------------------
 

@@ -137,6 +137,13 @@ class IntColumn:
         p = self._prefix_str()
         return (np.char.add(p, digits) if p else digits).tolist()
 
+    def decode_take(self, idx: np.ndarray) -> List[Optional[str]]:
+        """Arbitrary-index decode off the host mirror (the batched
+        lookup engine's gather-then-decode path)."""
+        digits = self.values_host()[idx].astype(np.str_)
+        p = self._prefix_str()
+        return (np.char.add(p, digits) if p else digits).tolist()
+
     def equality_term(self, value: str):
         """The int32 target *value* compares equal to on this column, or
         None when no cell can ever equal it (wrong prefix / non-canonical
@@ -232,6 +239,9 @@ class IntColumn:
 
     def find_code(self, value: str) -> int:
         return self._demote().find_code(value)
+
+    def find_codes(self, values) -> np.ndarray:
+        return self._demote().find_codes(values)
 
     def with_codes(self, codes, dev_dict_sorted=None):
         return self._demote().with_codes(codes, dev_dict_sorted)

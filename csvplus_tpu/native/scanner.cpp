@@ -162,6 +162,14 @@ int64_t csv_scan(const char* buf, int64_t len, char delim, char comment,
             return CSV_ERR_QUOTE;
           }
           if (c == '\r' && pos + 1 < len && buf[pos + 1] == '\n') {
+            if (pos + 2 >= len) {
+              // CRLF directly at EOF is a record terminator, not field
+              // data (csvio.py strips each line's terminator before
+              // scanning) — defer to the EOF-inside-quotes handler,
+              // which strips it from the segment
+              pos += 2;
+              continue;
+            }
             // line break inside quotes normalizes to '\n'
             to_scratch_mode(pos);
             flush_segment(pos);

@@ -363,6 +363,72 @@ class DeviceIndex:
         )
         return lower, upper
 
+    def point_bounds_many(
+        self, probes: Sequence[Sequence[str]]
+    ) -> List[Tuple[int, int]]:
+        """Batched :meth:`point_bounds`: one vectorized code translation
+        per key column (``find_codes``) and ONE searchsorted pass per
+        storage tier over all probes, instead of per-probe binary
+        searches and device dispatches.  Semantics match a loop of
+        single ``point_bounds`` calls exactly.
+        """
+        assert self.supported
+        m = len(probes)
+        if m == 0:
+            return []
+        n = int(self.table.nrows)
+        karr = np.array([len(p) for p in probes], dtype=np.int64)
+        if karr.size and int(karr.max()) > len(self.key_columns):
+            raise ValueError("too many columns in Index.find()")
+        qk = np.zeros(m, dtype=np.int64)
+        ok = np.ones(m, dtype=bool)
+        for j, (name, s) in enumerate(zip(self.key_columns, self.shifts)):
+            col = self.table.columns[name]
+            if int(karr.min()) > j:  # every probe has column j
+                codes = col.find_codes([p[j] for p in probes])
+                ok &= codes >= 0
+                qk |= np.where(codes >= 0, codes, 0) << s
+                continue
+            sel = np.flatnonzero(karr > j)
+            if sel.size == 0:
+                break
+            codes = col.find_codes([probes[i][j] for i in sel])
+            ok[sel] &= codes >= 0
+            qk[sel] |= np.where(codes >= 0, codes, 0) << s
+        shifts = np.array(self.shifts, dtype=np.int64)
+        range_size = np.where(karr > 0, 1 << shifts[np.maximum(karr, 1) - 1], 0)
+        top = qk + range_size
+        if self.packed_i32 is not None:
+            over = top > np.iinfo(np.int32).max  # one-past-top: upper = n
+            if int(self.packed_i32.shape[0]) <= self.POINT_MIRROR_MAX_KEYS:
+                host = getattr(self, "_packed_host", None)
+                if host is None:
+                    host = self._packed_host = np.asarray(self.packed_i32)
+                lower = host.searchsorted(qk.astype(np.int32), side="left")
+                upper = host.searchsorted(
+                    np.where(over, 0, top).astype(np.int32), side="left"
+                )
+            else:
+                qt = np.concatenate([qk, np.where(over, 0, top)]).astype(np.int32)
+                res = np.asarray(
+                    jnp.searchsorted(
+                        self.packed_i32, jnp.asarray(qt), side="left"
+                    )
+                )
+                lower, upper = res[:m], res[m:]
+            upper = np.where(over, n, upper)
+        else:
+            lower = np.searchsorted(self.packed_i64, qk, side="left")
+            upper = np.searchsorted(self.packed_i64, top, side="left")
+        lower = np.where(ok, lower, 0).astype(np.int64)
+        upper = np.where(ok, upper, 0).astype(np.int64)
+        empty = karr == 0  # empty prefix bounds the whole table
+        lower = np.where(empty, 0, lower)
+        upper = np.where(empty, n, upper)
+        # tolist() converts to native ints in C — a python int() pair per
+        # probe costs more than the searchsorted itself at 10K probes
+        return list(zip(lower.tolist(), upper.tolist()))
+
     def _partitioned_for(self, qk_sh):
         """Range-partitioned build keys for *qk_sh*'s mesh, cached per
         device set (mirrors _keys_for's replication cache — the O(n)

@@ -133,8 +133,28 @@ def to_rows(src) -> List[Row]:
     A device-planned source executes its fused plan inside ``src(fn)``
     (see :func:`csvplus_tpu.columnar.exec.plan_runner`), so sinks need no
     device special-casing — and error wrapping is identical either way."""
+    hint = getattr(src, "_rows_hint", None)
+    if hint is not None:
+        # take_rows-backed source (the point-lookup hot path): clone
+        # straight off the backing list — identical to what iterate()
+        # would deliver, minus the per-row callback machinery
+        return [Row(r) for r in hint]
     out: List[Row] = []
     src(out.append)
+    return out
+
+
+def to_rows_many(sources) -> List[List[Row]]:
+    """Materialize a batch of sources — one Row list per source, in
+    order.  The natural sink for :meth:`Index.find_many` results: the
+    batched lookup engine has already amortized the search and decode,
+    so this is pure iteration."""
+    out = []
+    for src in sources:
+        hint = getattr(src, "_rows_hint", None)
+        out.append(
+            [Row(r) for r in hint] if hint is not None else to_rows(src)
+        )
     return out
 
 

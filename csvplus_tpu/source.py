@@ -68,13 +68,17 @@ class DataSource:
     :func:`csvplus_tpu.reader.from_file`.
     """
 
-    __slots__ = ("_run", "plan", "_plan_unsupported", "plan_note")
+    __slots__ = ("_run", "plan", "_plan_unsupported", "plan_note", "_rows_hint")
 
     def __init__(self, run: Callable[[RowFunc], None], plan: Any = None):
         self._run = run
         self.plan = plan  # symbolic plan IR node, or None (host-only chain)
         self._plan_unsupported = False  # memo: device plan known-unsupported
         self.plan_note = None  # why device execution stopped, if it did
+        # already-materialized backing rows (take_rows sources): sinks
+        # may clone straight off this list instead of driving the
+        # callback machinery per row — the point-lookup hot path
+        self._rows_hint = None
 
     def explain(self) -> str:
         """Human-readable execution plan: the device plan when the chain
@@ -463,7 +467,7 @@ class DataSource:
             from . import plan as P
 
             node = self.plan
-            while not isinstance(node, P.Scan):
+            while not isinstance(node, (P.Scan, P.Lookup)):
                 node = node.child
             device = node.table.device
         return DeviceTable.from_rows(self.to_rows(), device=device)
@@ -547,7 +551,9 @@ def take_rows(rows: Iterable[Row]) -> DataSource:
     def run(fn: RowFunc) -> None:
         iterate(rows, fn)
 
-    return DataSource(run)
+    ds = DataSource(run)
+    ds._rows_hint = rows
+    return ds
 
 
 def take(src: Any) -> DataSource:

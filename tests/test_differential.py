@@ -31,6 +31,27 @@ from csvplus_tpu.columnar.table import DeviceTable
 _COLS = ["a", "b", "c"]
 _VALS = ["", "x", "y", "zz", "Zoë", " sp", '"q"']
 
+# fixed side table for random join/except stages: duplicate "x" keys
+# exercise multi-match fan-out, and the device copy exercises the
+# lowered probe path (the host oracle decodes it through materialize())
+_SIDE_ROWS = [
+    Row({"a": "x", "d": "d0"}),
+    Row({"a": "y", "d": "d1"}),
+    Row({"a": "zz", "d": "d2"}),
+    Row({"a": "x", "d": "d3"}),
+]
+
+
+_side_cache = []
+
+
+def _side_index():
+    if not _side_cache:  # built once; join/except never mutate an index
+        idx = TakeRows(_SIDE_ROWS).index_on("a")
+        idx.on_device("cpu")
+        _side_cache.append(idx)
+    return _side_cache[0]
+
 
 @st.composite
 def tables(draw, min_rows=0, max_rows=24):
@@ -46,7 +67,19 @@ def tables(draw, min_rows=0, max_rows=24):
 def stages(draw):
     kind = draw(
         st.sampled_from(
-            ["filter", "select", "dropc", "top", "drop", "map", "tw", "dw"]
+            [
+                "filter",
+                "select",
+                "dropc",
+                "top",
+                "drop",
+                "map",
+                "tw",
+                "dw",
+                "join",
+                "except",
+                "validate",
+            ]
         )
     )
     if kind == "filter":
@@ -58,6 +91,10 @@ def stages(draw):
                 All(Like({"a": "x"}), Not(Like({"b": ""}))),
                 Any(Like({"a": "Zoë"}), Like({"b": " sp"})),
                 Like({"nope": "x"}),
+                # hit the typed-ingest tables: int32-lane equality and a
+                # multi-lane (>4 byte) dictionary probe
+                Like({"a": "7"}),
+                Any(Like({"b": "omega-long-value"}), Like({"a": "4095"})),
             ]
         )
         return ("filter", draw(preds))
@@ -74,6 +111,15 @@ def stages(draw):
             [Like({"a": "x"}), Not(Like({"b": "y"})), Like({"nope": "q"})]
         )
         return (kind, draw(preds))
+    if kind in ("join", "except"):
+        # mid-chain (anti-)join against the fixed side index; joining on
+        # a column the stream may lack errors equally on both paths
+        return (kind, ("a",))
+    if kind == "validate":
+        preds = st.sampled_from(
+            [Like({"a": "x"}), Not(Like({"c": "zz"})), Like({"b": "y"})]
+        )
+        return ("validate", draw(preds))
     return (
         "map",
         draw(
@@ -100,6 +146,12 @@ def apply_stages(src, pipeline):
             src = src.take_while(arg)
         elif kind == "dw":
             src = src.drop_while(arg)
+        elif kind == "join":
+            src = src.join(_side_index(), *arg)
+        elif kind == "except":
+            src = src.except_(_side_index(), *arg)
+        elif kind == "validate":
+            src = src.validate(arg, "differential validate")
         else:
             src = src.map(arg)
     return src
@@ -124,8 +176,13 @@ def check_verifier_verdicts(plan, host, dev):
     report = verify_plan(plan)
     # a host-side runtime column error must have been anticipated by a
     # resolution diagnostic; equivalently, a resolution-silent report
-    # with no errors guarantees the host path succeeds
-    if not report.by_rule("resolution") and not report.errors:
+    # with no errors and no data-dependent abort (Validate) guarantees
+    # the host path succeeds
+    if (
+        not report.by_rule("resolution")
+        and not report.errors
+        and not report.by_rule("data-dependent")
+    ):
         assert host[0] == "rows", (host, report.describe())
     # a proof of emptiness is a proof about BOTH paths
     if report.predicts_empty:
@@ -208,6 +265,118 @@ def test_random_pipeline_sharded_matches_host(rows, pipeline):
         assert dev == host
     else:
         assert dev[0] == "error"
+
+
+# digit-only values give column "a" a typed int32 lane on CSV ingest;
+# the wide values give column "b" a multi-lane (>4 byte) dictionary
+_INT_VALS = ["0", "1", "7", "42", "100", "4095"]
+_WIDE_VALS = ["x", "alpha", "omega-long-value", "Zoë-λ", "xxxxxxxxxxxx"]
+
+
+@st.composite
+def typed_csv_rows(draw, max_rows=20):
+    n = draw(st.integers(0, max_rows))
+    return [
+        (draw(st.sampled_from(_INT_VALS)), draw(st.sampled_from(_WIDE_VALS)))
+        for _ in range(n)
+    ]
+
+
+@given(typed_csv_rows(), st.lists(stages(), min_size=0, max_size=4))
+def test_random_pipeline_typed_ingest_matches_host(spec, pipeline):
+    """Typed IntColumn / lane-dictionary tables under the same random
+    pipeline vocabulary: CSV ingest (the only route to typed lanes)
+    on device vs the host oracle over the identical file."""
+    import os
+    import tempfile
+
+    from csvplus_tpu import from_file
+
+    fd, path = tempfile.mkstemp(suffix=".csv")
+    os.close(fd)
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("a,b\n")
+            f.writelines(f"{x},{y}\n" for x, y in spec)
+        host = run_either(Take(from_file(path)), pipeline)
+        dev_src = apply_stages(from_file(path).on_device("cpu"), pipeline)
+        dev = run_either(dev_src, [])
+        check_verifier_verdicts(getattr(dev_src, "plan", None), host, dev)
+        if host[0] == "rows":
+            assert dev == host
+        else:
+            assert dev[0] == "error"
+    finally:
+        os.unlink(path)
+
+
+_FIXED_TABLES = [
+    [],
+    [Row({"a": "x", "b": "y", "c": "zz"})],
+    [Row({"a": v, "b": w}) for v in _VALS for w in ("y", "")],
+    [Row({"b": "y"}), Row({"a": "x", "b": "y"})],  # "a" partially absent
+    [Row({"a": "x"})] * 6 + [Row({"a": "zz"})] * 3,  # join fan-out
+]
+
+_FIXED_PIPELINES = [
+    [("join", ("a",))],
+    [("except", ("a",))],
+    [("validate", Like({"a": "x"}))],
+    [("filter", Like({"a": "x"})), ("join", ("a",)), ("top", 4)],
+    [("join", ("a",)), ("except", ("a",))],  # except sees joined schema
+    [("drop", 2), ("validate", Not(Like({"c": "zz"}))), ("join", ("a",))],
+    [("join", ("a",)), ("join", ("a",))],  # double fan-out
+    [("dropc", ("a",)), ("join", ("a",))],  # join key dropped upstream
+    [("validate", Like({"b": "y"})), ("tw", Like({"a": "x"}))],
+]
+
+
+def test_widened_vocabulary_fixed_examples():
+    """Deterministic floor under the random generator: the join /
+    except_ / mid-chain validate stages hold device == host parity on
+    fixed shapes even where hypothesis is not installed."""
+    for rows in _FIXED_TABLES:
+        for pipeline in _FIXED_PIPELINES:
+            host = run_either(take_rows(rows), pipeline)
+            dev_src = apply_stages(
+                source_from_table(DeviceTable.from_rows(rows, device="cpu")),
+                pipeline,
+            )
+            dev = run_either(dev_src, [])
+            check_verifier_verdicts(getattr(dev_src, "plan", None), host, dev)
+            if host[0] == "rows":
+                assert dev == host, (rows, pipeline)
+            else:
+                assert dev[0] == "error", (rows, pipeline)
+
+
+def test_typed_ingest_fixed_examples(tmp_path):
+    """Deterministic floor under the typed-ingest generator: IntColumn
+    and multi-lane dictionary tables through the widened vocabulary."""
+    from csvplus_tpu import from_file
+
+    path = tmp_path / "typed.csv"
+    path.write_text(
+        "a,b\n"
+        + "".join(
+            f"{x},{y}\n"
+            for x, y in zip(_INT_VALS * 3, (_WIDE_VALS * 4)[: len(_INT_VALS) * 3])
+        )
+    )
+    pipelines = _FIXED_PIPELINES + [
+        [("filter", Like({"a": "7"}))],
+        [("filter", Any(Like({"b": "omega-long-value"}), Like({"a": "4095"})))],
+        [("validate", Not(Like({"a": "nope"}))), ("top", 5)],
+    ]
+    for pipeline in pipelines:
+        host = run_either(Take(from_file(str(path))), pipeline)
+        dev_src = apply_stages(from_file(str(path)).on_device("cpu"), pipeline)
+        dev = run_either(dev_src, [])
+        check_verifier_verdicts(getattr(dev_src, "plan", None), host, dev)
+        if host[0] == "rows":
+            assert dev == host, pipeline
+        else:
+            assert dev[0] == "error", pipeline
 
 
 @given(tables(min_rows=0, max_rows=20))

@@ -1,0 +1,213 @@
+"""Batched point-lookup engine: Index.find_many / FindMany / to_rows_many.
+
+Parity contract: for every probe batch, `find_many(probes)` is byte-
+identical to the matching loop of single `find` calls — across the host
+row tier, the device mirror tier, the above-mirror-cap device tier, the
+wide-key (int64) tier, and typed IntColumn key columns.  Plus the LRU
+regressions: bounded eviction never corrupts results, and `dedup` never
+leaves stale decoded blocks behind.
+"""
+
+import numpy as np
+import pytest
+
+import csvplus_tpu as cp
+from csvplus_tpu import Row, Take, TakeRows, from_file, to_rows_many
+from csvplus_tpu.columnar.table import DeviceTable
+from csvplus_tpu.ops.join import DeviceIndex
+from csvplus_tpu.sinks import to_rows
+
+
+def _norm(p):
+    return (p,) if isinstance(p, str) else tuple(p)
+
+
+def assert_batched_matches_looped(index, probes):
+    batched = to_rows_many(index.find_many(probes))
+    looped = [to_rows(index.find(*_norm(p))) for p in probes]
+    assert batched == looped
+    return batched
+
+
+PROBES = [
+    "Amelia",  # bare string = one-column prefix
+    ("Amelia", "Hill"),  # full-width
+    (),  # empty prefix: whole index
+    ("nobody",),  # miss
+    "Amelia",  # duplicate probe
+    ("Amelia", "nope"),  # present prefix, missing suffix
+    ("Zoe",),
+]
+
+
+@pytest.fixture()
+def host_index(people_csv):
+    return Take(from_file(people_csv)).index_on("name", "surname")
+
+
+@pytest.fixture()
+def dev_index(people_csv):
+    return from_file(people_csv).on_device("cpu").index_on("name", "surname")
+
+
+def test_host_tier_parity(host_index):
+    groups = assert_batched_matches_looped(host_index, PROBES)
+    assert len(groups[0]) == 12 and groups[3] == [] and len(groups[2]) == 120
+
+
+def test_device_mirror_tier_parity(dev_index):
+    assert dev_index._impl.is_lazy
+    groups = assert_batched_matches_looped(dev_index, PROBES)
+    assert len(groups[0]) == 12 and groups[3] == []
+    assert dev_index._impl.is_lazy  # lookups never materialize host rows
+
+
+def test_device_above_mirror_cap_parity(people_csv, monkeypatch):
+    # force the one-gather to_rows tier (cells gate fails at cap 1)
+    monkeypatch.setattr(DeviceIndex, "POINT_MIRROR_MAX_KEYS", 1)
+    idx = from_file(people_csv).on_device("cpu").index_on("name", "surname")
+    assert_batched_matches_looped(idx, PROBES)
+
+
+def test_wide_key_i64_tier_parity():
+    # two ~2^9-distinct key columns *3 would stay narrow; use columns wide
+    # enough that total bits exceed 31 -> packed_i64 host tier
+    n = 70_000
+    a = [f"a{i % 40000:05d}" for i in range(n)]
+    b = [f"b{(i * 7) % 40000:05d}" for i in range(n)]
+    t = DeviceTable.from_pylists({"a": a, "b": b}, device="cpu")
+    idx = Take(t).index_on("a", "b")
+    assert idx._impl.dev.packed_i64 is not None  # really the wide tier
+    probes = ["a00017", ("a00017", "b00119"), ("a39999",), ("zz",), "a00017"]
+    assert_batched_matches_looped(idx, probes)
+
+
+def test_typed_int_key_parity(tmp_path):
+    path = tmp_path / "typed.csv"
+    path.write_text(
+        "cust_id,v\n" + "".join(f"c{i % 500},{i}\n" for i in range(2000))
+    )
+    src = from_file(str(path)).on_device("cpu")
+    if src.plan.table.columns["cust_id"].kind == "int":  # typed lanes on
+        idx = src.index_on("cust_id")
+        probes = ["c3", "c499", "c500", "cX", "c3", ("c42",)]
+        assert_batched_matches_looped(idx, probes)
+    else:  # CSVPLUS_TYPED_LANES=0 runs: still exercise the parity
+        idx = src.index_on("cust_id")
+        assert_batched_matches_looped(idx, ["c3", "cX"])
+
+
+def test_empty_probe_list(host_index, dev_index):
+    assert host_index.find_many([]) == []
+    assert dev_index.find_many([]) == []
+    assert to_rows_many([]) == []
+
+
+def test_prefix_length_mix_and_duplicates(dev_index, host_index):
+    probes = [(), "Amelia", ("Amelia", "Hill"), (), ("Amelia", "Hill"), "Amelia"]
+    hb = assert_batched_matches_looped(host_index, probes)
+    db = assert_batched_matches_looped(dev_index, probes)
+    assert hb == db
+    assert hb[1] == hb[5] and hb[2] == hb[4]  # duplicate probes agree
+
+
+def test_too_many_columns(host_index, dev_index):
+    for idx in (host_index, dev_index):
+        with pytest.raises(ValueError, match="too many columns"):
+            idx.find_many([("a", "b", "c")])
+
+
+def test_go_style_aliases(dev_index):
+    assert cp.Index.FindMany is cp.Index.find_many
+    assert cp.ToRowsMany is cp.to_rows_many
+    assert to_rows_many(dev_index.FindMany(["Amelia"])) == [
+        to_rows(dev_index.find("Amelia"))
+    ]
+
+
+def test_find_many_sources_carry_device_plan(dev_index):
+    from csvplus_tpu.plan import Lookup
+
+    srcs = dev_index.find_many(["Amelia", ("nobody",)])
+    assert all(isinstance(s.plan, Lookup) for s in srcs)
+    # downstream symbolic stages stay lowerable and match the host path
+    flt = srcs[0].filter(cp.Like({"surname": "Hill"}))
+    assert flt.plan is not None
+    host = [r for r in to_rows(dev_index.find("Amelia")) if r["surname"] == "Hill"]
+    assert to_rows(flt) == host
+
+
+def test_find_many_host_tier_has_no_plan(host_index):
+    srcs = host_index.find_many(["Amelia"])
+    assert srcs[0].plan is None
+
+
+def test_lru_eviction_keeps_results_correct(people_csv, monkeypatch):
+    # cap the decoded-block LRU at one row: every lookup evicts, results
+    # must stay identical to the uncached path
+    monkeypatch.setenv("CSVPLUS_MIRROR_LRU_ROWS", "1")
+    idx = from_file(people_csv).on_device("cpu").index_on("name", "surname")
+    for _ in range(2):
+        assert_batched_matches_looped(idx, PROBES)
+
+
+def test_lru_repeat_hits_same_rows(dev_index):
+    first = to_rows_many(dev_index.find_many(["Amelia", "Amelia"]))
+    second = to_rows_many(dev_index.find_many(["Amelia"]))
+    assert first[0] == first[1] == second[0]
+
+
+def test_lru_not_stale_after_policy_dedup(people_csv):
+    """Regression: the decoded-block LRU must never serve pre-dedup rows.
+
+    Policy dedup rebuilds the device index over a gathered (new) table,
+    so cached blocks of the old table must not leak into post-dedup
+    lookups."""
+    di = from_file(people_csv).on_device("cpu").index_on("name")
+    hi = Take(from_file(people_csv)).index_on("name")
+    # warm the LRU with pre-dedup blocks
+    pre = to_rows_many(di.find_many(["Amelia", "Zoe"]))
+    assert len(pre[0]) == 12
+    di.resolve_duplicates("first")
+    hi.resolve_duplicates("first")
+    post = to_rows_many(di.find_many(["Amelia", "Zoe"]))
+    assert post == [to_rows(hi.find("Amelia")), to_rows(hi.find("Zoe"))]
+    assert len(post[0]) == 1  # deduped, not the stale 12-row block
+
+
+def test_lru_not_stale_after_callback_dedup(people_csv):
+    """Callback dedup drops the device copy entirely; find_many must
+    switch to the host tier and see the resolved rows."""
+    di = from_file(people_csv).on_device("cpu").index_on("name")
+    hi = Take(from_file(people_csv)).index_on("name")
+    _ = to_rows_many(di.find_many(["Amelia"]))  # warm pre-dedup
+    pick = lambda g: g[-1]  # noqa: E731
+    di.resolve_duplicates(pick)
+    hi.resolve_duplicates(pick)
+    assert to_rows_many(di.find_many(["Amelia", "Zoe"])) == [
+        to_rows(hi.find("Amelia")),
+        to_rows(hi.find("Zoe")),
+    ]
+
+
+def test_find_routed_through_engine(dev_index):
+    """Single find IS the batched engine: same bounds, same decode."""
+    rows = to_rows(dev_index.find("Amelia", "Hill"))
+    batched = to_rows_many(dev_index.find_many([("Amelia", "Hill")]))
+    assert batched == [rows]
+
+
+def test_find_many_accepts_lists_and_tuples(host_index):
+    a = to_rows_many(host_index.find_many([["Amelia", "Hill"]]))
+    b = to_rows_many(host_index.find_many([("Amelia", "Hill")]))
+    assert a == b
+
+
+def test_rows_from_mirror_many_empty_and_dup_ranges():
+    t = DeviceTable.from_pylists({"k": ["a", "b", "c", "d"]}, device="cpu")
+    got = t.rows_from_mirror_many([(1, 3), (0, 0), (1, 3), (3, 4)])
+    assert got[0] == [Row({"k": "b"}), Row({"k": "c"})]
+    assert got[1] == []
+    assert got[2] == got[0]
+    assert got[3] == [Row({"k": "d"})]
+    assert t.rows_from_mirror(1, 3) == got[0]
