@@ -1,7 +1,7 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro dryrun example coldcheck lint
+.PHONY: test soak bench bench-micro bench-mesh dryrun example coldcheck lint
 
 test:
 	python -m pytest tests/ -x -q
@@ -31,6 +31,15 @@ bench:
 # exits nonzero on a >2x regression vs bench_micro_floor.json.
 bench-micro:
 	JAX_PLATFORMS=cpu python bench.py --micro-lookup
+
+# Minutes-long gate of the SHARDED north-star pipeline (virtual 8-device
+# CPU mesh, 10M rows by default): one JSON line with the warm sharded
+# 3-way join rows/s; exits nonzero on a >2x regression vs
+# bench_mesh_floor.json.  The checked-in record artifact
+# (NORTHSTAR_MESH_r06.json) is only (re)written by record-tier runs:
+#   CSVPLUS_BENCH_MESH_ROWS=100000000 make bench-mesh
+bench-mesh:
+	python bench.py --bench-mesh
 
 dryrun:
 	python __graft_entry__.py

@@ -567,11 +567,7 @@ class StringColumn:
             )
         # negative codes pass through unchanged (-1 absent stays -1,
         # -2 sharding pads stay -2), same as the empty-lane early return
-        return jnp.where(
-            self.codes >= 0,
-            jnp.take(trans, jnp.clip(self.codes, 0), axis=0),
-            self.codes,
-        )
+        return _apply_code_translation(self.codes, trans)
 
     def renumbered_to(self, other_dictionary: np.ndarray) -> jax.Array:
         """Translate this column's codes into another dictionary's code
@@ -593,11 +589,17 @@ class StringColumn:
         # unmatched becomes -1; negative codes pass through unchanged
         # (-1 absent stays -1, -2 sharding pads stay -2) so both
         # translation paths keep the same negative-code identity
-        return jnp.where(
-            self.codes >= 0,
-            jnp.take(jnp.asarray(trans_dev), jnp.clip(self.codes, 0), axis=0),
-            self.codes,
-        )
+        return _apply_code_translation(self.codes, jnp.asarray(trans_dev))
+
+
+@jax.jit
+def _apply_code_translation(codes: jax.Array, trans: jax.Array) -> jax.Array:
+    """``trans[codes]`` with negative codes passed through unchanged —
+    one fused kernel instead of three eager passes (the translation runs
+    per probe execution on the warm-join path)."""
+    return jnp.where(
+        codes >= 0, jnp.take(trans, jnp.clip(codes, 0), axis=0), codes
+    )
 
 
 @jax.jit

@@ -281,32 +281,21 @@ class IntColumn:
     def _translate_by_values(self, state) -> jax.Array:
         """Rows translated through a :meth:`_build_translation` state;
         miss -> -1, sharding pads -> -2 (the same negative-code identity
-        the StringColumn translation preserves)."""
-        is_pad = self.values == jnp.int32(PAD_VALUE)
+        the StringColumn translation preserves).
+
+        Each variant is ONE jitted kernel (r6 warm-join recovery): the
+        translation runs on every probe execution, and the previous
+        eager form paid ~6 unfused device passes over the full probe
+        length per key column per join — measured 76.6ms vs 10.6ms
+        fused at 10M rows.  The dense base offset rides as a traced
+        scalar so distinct build sides share one executable."""
         if state[0] == "dense":
             _, lo, table = state
-            # pads masked BEFORE the subtraction: PAD_VALUE - lo wraps
-            # int32 and could land inside the dense range
-            safe = jnp.where(is_pad, jnp.int32(lo), self.values)
-            idx = safe - jnp.int32(lo)
-            ok = (idx >= 0) & (idx < table.shape[0]) & ~is_pad
-            got = jnp.take(table, jnp.clip(idx, 0, table.shape[0] - 1), axis=0)
-            return jnp.where(ok, got, jnp.where(is_pad, jnp.int32(-2), jnp.int32(-1)))
+            return _translate_dense_kernel(self.values, jnp.int32(lo), table)
         _, sorted_vals, code_of = state
         if int(sorted_vals.shape[0]) == 0:
-            return jnp.where(
-                is_pad,
-                jnp.int32(-2),
-                jnp.full(self.values.shape, -1, jnp.int32),
-            )
-        pos = jnp.searchsorted(sorted_vals, self.values)
-        pos = jnp.minimum(pos, sorted_vals.shape[0] - 1)
-        hit = (jnp.take(sorted_vals, pos, axis=0) == self.values) & ~is_pad
-        return jnp.where(
-            hit,
-            jnp.take(code_of, pos, axis=0),
-            jnp.where(is_pad, jnp.int32(-2), jnp.int32(-1)),
-        )
+            return _translate_empty_kernel(self.values)
+        return _translate_sorted_kernel(self.values, sorted_vals, code_of)
 
     def renumbered_to(self, other_dictionary: np.ndarray) -> jax.Array:
         """Translate rows into *other_dictionary*'s code space without
@@ -335,6 +324,38 @@ class IntColumn:
             cand, vals = parse_affix_dictionary(other.dictionary, self.prefix)
             hit = cache[self.prefix] = self._build_translation(vals, cand)
         return self._translate_by_values(hit)
+
+
+@jax.jit
+def _translate_dense_kernel(values, lo, table):
+    is_pad = values == jnp.int32(PAD_VALUE)
+    # pads masked BEFORE the subtraction: PAD_VALUE - lo wraps int32 and
+    # could land inside the dense range
+    safe = jnp.where(is_pad, lo, values)
+    idx = safe - lo
+    ok = (idx >= 0) & (idx < table.shape[0]) & ~is_pad
+    got = jnp.take(table, jnp.clip(idx, 0, table.shape[0] - 1), axis=0)
+    return jnp.where(ok, got, jnp.where(is_pad, jnp.int32(-2), jnp.int32(-1)))
+
+
+@jax.jit
+def _translate_sorted_kernel(values, sorted_vals, code_of):
+    is_pad = values == jnp.int32(PAD_VALUE)
+    pos = jnp.searchsorted(sorted_vals, values)
+    pos = jnp.minimum(pos, sorted_vals.shape[0] - 1)
+    hit = (jnp.take(sorted_vals, pos, axis=0) == values) & ~is_pad
+    return jnp.where(
+        hit,
+        jnp.take(code_of, pos, axis=0),
+        jnp.where(is_pad, jnp.int32(-2), jnp.int32(-1)),
+    )
+
+
+@jax.jit
+def _translate_empty_kernel(values):
+    return jnp.where(
+        values == jnp.int32(PAD_VALUE), jnp.int32(-2), jnp.int32(-1)
+    )
 
 
 def format_affix(prefix: bytes, values: np.ndarray) -> np.ndarray:

@@ -498,18 +498,29 @@ class DeviceIndex:
         Fewer probe columns than key columns = a prefix probe matching the
         whole key range under the prefix.
         """
+        from ..utils.observe import telemetry
+
         assert self.supported
         k = len(probe_cols)
-        codes = self._translated(probe_cols, k)
+        with telemetry.stage("join:translate", nrows):
+            codes = self._translated(probe_cols, k)
+            telemetry.barrier(codes)
         range_shift = self.shifts[k - 1] if k else 0
 
         if self.packed_i32 is not None:
-            qk = jnp.zeros(nrows, dtype=jnp.int32)
-            ok = jnp.ones(nrows, dtype=bool)
-            for c, s in zip(codes, self.shifts):
-                ok = ok & (c >= 0)
-                qk = qk | (jnp.where(c >= 0, c, 0).astype(jnp.int32) << s)
-            qk = jnp.where(ok, qk, jnp.int32(-1))
+            with telemetry.stage("join:pack", nrows):
+                if codes:
+                    # one fused kernel per execution: the eager
+                    # mask/shift/or loop cost ~94ms per key column at 10M
+                    # rows vs 8ms fused (r6 warm-join recovery); shifts
+                    # are static so the trace count is bounded by
+                    # distinct (key-width, shape) pairs
+                    qk = _pack_qk_kernel(
+                        tuple(codes), tuple(self.shifts[: len(codes)])
+                    )
+                else:
+                    qk = jnp.zeros(nrows, dtype=jnp.int32)
+                telemetry.barrier(qk)
 
             # large build sides probed by a MESH-SHARDED stream: don't
             # replicate — range-partition the key array across the
@@ -536,11 +547,21 @@ class DeviceIndex:
 
             if self.direct_cum is not None:
                 cum = self._lanes_for(qk, "direct_cum")
-                return _probe_kernel_direct(cum, qk, jnp.int32(1) << range_shift)
+                with telemetry.stage("join:probe", nrows) as out:
+                    out["tier"] = "direct"
+                    ans = _probe_kernel_direct(
+                        cum, qk, jnp.int32(1) << range_shift
+                    )
+                    telemetry.barrier(ans)
+                return ans
             keys = self._keys_for(qk)
             # stays on device: fan-out expansion and gathers consume these
             # directly, so no O(n) host sync happens in the probe
-            return _probe_kernel_i32(keys, qk, jnp.int32(1) << range_shift)
+            with telemetry.stage("join:probe", nrows) as out:
+                out["tier"] = "broadcast-i32"
+                ans = _probe_kernel_i32(keys, qk, jnp.int32(1) << range_shift)
+                telemetry.barrier(ans)
+            return ans
 
         # wide keys: dual 31-bit lane probe, fully on device (no x64)
         ok = jnp.ones(nrows, dtype=bool)
@@ -582,6 +603,20 @@ class DeviceIndex:
             jnp.int32(range_size & _MASK31),
             ok,
         )
+
+
+@_partial(jax.jit, static_argnames=("shifts",))
+def _pack_qk_kernel(  # analysis: allow[JIT001] retrace is per join-key ARITY (bounded by the 31-bit pack budget), not per data length
+    codes: Tuple[jax.Array, ...], shifts: Tuple[int, ...]
+) -> jax.Array:
+    """Packed int32 probe key from translated per-column codes; any
+    negative code (miss -1 / pad -2) marks the whole row -1."""
+    ok = jnp.ones(codes[0].shape, dtype=bool)
+    qk = jnp.zeros(codes[0].shape, dtype=jnp.int32)
+    for c, s in zip(codes, shifts):
+        ok = ok & (c >= 0)
+        qk = qk | (jnp.where(c >= 0, c, 0).astype(jnp.int32) << s)
+    return jnp.where(ok, qk, jnp.int32(-1))
 
 
 def expand_matches(
@@ -729,30 +764,44 @@ def join_tables(
         }
         return DeviceTable(out_cols, 0, stream.device)
 
+    from ..utils.observe import telemetry
+
     probe_cols = _checked_probe_cols(stream, columns)
     lower, counts = dev_index.probe(probe_cols, stream.nrows)
     probe_ids = build_ids = None
-    if isinstance(lower, jax.Array):
-        # (total matches, max run length) in ONE host transfer; a unique
-        # build side (max run 1 — the reference's flagship shape) skips
-        # the O(n) fan-out expansion entirely
-        total, maxc = (int(v) for v in np.asarray(_probe_stats(lower, counts)))
-        if maxc <= 1 and total == stream.nrows:
-            # every stream row matched exactly once: identity on the
-            # stream side (columns pass through ungathered, caches
-            # intact), build rows addressed by the probe's lower bounds
-            build_ids = lower
-        elif maxc <= 1:
-            # unique but partial: compact the selection without the
-            # expansion scan; pow2-padded flatnonzero bounds recompiles
-            padded = 1 << max(total - 1, 0).bit_length() if total else 1
-            sel = jnp.flatnonzero(counts > 0, size=padded, fill_value=0)
-            probe_ids = sel[:total].astype(jnp.int32)
-            build_ids = jnp.take(lower, probe_ids, axis=0)
-        else:
-            probe_ids, build_ids = expand_matches_device(lower, counts, total)
-    else:  # the partitioned (multi-chip) tier answers in numpy
-        probe_ids, build_ids = expand_matches(lower, counts)
+    with telemetry.stage("join:expand", stream.nrows) as _exp:
+        if isinstance(lower, jax.Array):
+            # (total matches, max run length) in ONE host transfer; a
+            # unique build side (max run 1 — the reference's flagship
+            # shape) skips the O(n) fan-out expansion entirely
+            total, maxc = (
+                int(v) for v in np.asarray(_probe_stats(lower, counts))
+            )
+            if maxc <= 1 and total == stream.nrows:
+                # every stream row matched exactly once: identity on the
+                # stream side (columns pass through ungathered, caches
+                # intact), build rows addressed by the probe's lower bounds
+                build_ids = lower
+                _exp["path"] = "unique-identity"
+            elif maxc <= 1:
+                # unique but partial: compact the selection without the
+                # expansion scan; pow2-padded flatnonzero bounds recompiles
+                padded = 1 << max(total - 1, 0).bit_length() if total else 1
+                sel = jnp.flatnonzero(counts > 0, size=padded, fill_value=0)
+                probe_ids = sel[:total].astype(jnp.int32)
+                build_ids = jnp.take(lower, probe_ids, axis=0)
+                _exp["path"] = "unique-partial"
+            else:
+                probe_ids, build_ids = expand_matches_device(
+                    lower, counts, total
+                )
+                _exp["path"] = "fan-out"
+            _exp["rows_out"] = total
+        else:  # the partitioned (multi-chip) tier answers in numpy
+            probe_ids, build_ids = expand_matches(lower, counts)
+            _exp["path"] = "host-expand"
+            _exp["rows_out"] = len(probe_ids)
+        telemetry.barrier((probe_ids, build_ids))
 
     build_names = list(dev_index.table.columns)
     stream_names = list(stream.columns)
@@ -765,51 +814,54 @@ def join_tables(
     )
     stream_codes = tuple(stream.columns[n].storage for n in stream_names)
 
-    if probe_ids is None:
-        # all-matched unique fast path: stream columns pass through
-        # untouched; only the build side gathers (one jit call)
-        if same_placement(build_codes + (build_ids,)):
-            g_build = _gather_cols(build_codes, build_ids)
+    with telemetry.stage("join:merge", stream.nrows) as _mrg:
+        if probe_ids is None:
+            # all-matched unique fast path: stream columns pass through
+            # untouched; only the build side gathers (one jit call)
+            if same_placement(build_codes + (build_ids,)):
+                g_build = _gather_cols(build_codes, build_ids)
+            else:
+                b = jnp.asarray(build_ids, dtype=jnp.int32)
+                g_build = tuple(jnp.take(c, b, axis=0) for c in build_codes)
+            g_stream = stream_codes
+            n_out = stream.nrows
+        elif same_placement(build_codes + stream_codes):
+            # ALL row-materializing gathers in one jit call — per-column
+            # eager dispatches cost a round-trip each over tunneled backends
+            g_build, g_stream = _gather_both_sides(
+                build_codes, stream_codes, build_ids, probe_ids
+            )
+            n_out = len(probe_ids)
         else:
-            b = jnp.asarray(build_ids, dtype=jnp.int32)
-            g_build = tuple(jnp.take(c, b, axis=0) for c in build_codes)
-        g_stream = stream_codes
-        n_out = stream.nrows
-    elif same_placement(build_codes + stream_codes):
-        # ALL row-materializing gathers in one jit call — per-column
-        # eager dispatches cost a round-trip each over tunneled backends
-        g_build, g_stream = _gather_both_sides(
-            build_codes, stream_codes, build_ids, probe_ids
-        )
-        n_out = len(probe_ids)
-    else:
-        # mixed placements (e.g. the partitioned tier's numpy ids over a
-        # mesh-sharded stream with a single-device build table): eager
-        # per-column takes, each free to resolve its own placement
-        g_build = tuple(
-            jnp.take(c, jnp.asarray(build_ids, dtype=jnp.int32), axis=0)
-            for c in build_codes
-        )
-        g_stream = tuple(
-            jnp.take(c, jnp.asarray(probe_ids, dtype=jnp.int32), axis=0)
-            for c in stream_codes
-        )
-        n_out = len(probe_ids)
+            # mixed placements (e.g. the partitioned tier's numpy ids over a
+            # mesh-sharded stream with a single-device build table): eager
+            # per-column takes, each free to resolve its own placement
+            g_build = tuple(
+                jnp.take(c, jnp.asarray(build_ids, dtype=jnp.int32), axis=0)
+                for c in build_codes
+            )
+            g_stream = tuple(
+                jnp.take(c, jnp.asarray(probe_ids, dtype=jnp.int32), axis=0)
+                for c in stream_codes
+            )
+            n_out = len(probe_ids)
 
-    out_cols = {}
-    for name, codes in zip(build_names, g_build):
-        src = dev_index.table.columns[name]
-        out_cols[name] = src.with_storage(codes)
-    for name, codes in zip(stream_names, g_stream):  # stream wins on collision...
-        g = (
-            stream.columns[name]
-            if probe_ids is None
-            else stream.columns[name].with_storage(codes)
-        )
-        if name in out_cols:
-            # ...but an absent stream cell keeps the index value
-            g = merge_with_fallback(g, out_cols[name])
-        out_cols[name] = g
+        out_cols = {}
+        for name, codes in zip(build_names, g_build):
+            src = dev_index.table.columns[name]
+            out_cols[name] = src.with_storage(codes)
+        for name, codes in zip(stream_names, g_stream):  # stream wins on collision...
+            g = (
+                stream.columns[name]
+                if probe_ids is None
+                else stream.columns[name].with_storage(codes)
+            )
+            if name in out_cols:
+                # ...but an absent stream cell keeps the index value
+                g = merge_with_fallback(g, out_cols[name])
+            out_cols[name] = g
+        _mrg["rows_out"] = n_out
+        telemetry.barrier(tuple(c.storage for c in out_cols.values()))
     return DeviceTable(out_cols, n_out, stream.device)
 
 

@@ -312,32 +312,46 @@ def prepare_partitioned(mesh: Mesh, index_keys_sorted: np.ndarray):
     62-bit) keys -> a 6-tuple with the unique keys and splits as dual
     31-bit lanes (uniq_hi, uniq_lo, lower, count, splits_hi, splits_lo).
     """
+    from ..utils.observe import telemetry
+
     n_shards = mesh.devices.size
     rows = NamedSharding(mesh, row_spec(mesh))
     repl = NamedSharding(mesh, P())
-    if np.dtype(index_keys_sorted.dtype) == np.int64:
+    with telemetry.stage(
+        "join:partition", int(index_keys_sorted.shape[0])
+    ) as _p:
+        _p["n_shards"] = n_shards
+        if np.dtype(index_keys_sorted.dtype) == np.int64:
+            local, lower, count, splits = partition_build_keys(
+                index_keys_sorted, n_shards
+            )
+            lh, ll = split_lanes(local.reshape(-1))
+            sh, sl = split_lanes(splits)
+            return tuple(
+                telemetry.barrier(
+                    (
+                        jax.device_put(lh, rows),
+                        jax.device_put(ll, rows),
+                        jax.device_put(lower.reshape(-1), rows),
+                        jax.device_put(count.reshape(-1), rows),
+                        jax.device_put(sh, repl),
+                        jax.device_put(sl, repl),
+                    )
+                )
+            )
         local, lower, count, splits = partition_build_keys(
-            index_keys_sorted, n_shards
+            index_keys_sorted.astype(np.int32), n_shards
         )
-        lh, ll = split_lanes(local.reshape(-1))
-        sh, sl = split_lanes(splits)
-        return (
-            jax.device_put(lh, rows),
-            jax.device_put(ll, rows),
-            jax.device_put(lower.reshape(-1), rows),
-            jax.device_put(count.reshape(-1), rows),
-            jax.device_put(sh, repl),
-            jax.device_put(sl, repl),
+        return tuple(
+            telemetry.barrier(
+                (
+                    jax.device_put(local.reshape(-1), rows),
+                    jax.device_put(lower.reshape(-1), rows),
+                    jax.device_put(count.reshape(-1), rows),
+                    jax.device_put(splits, repl),
+                )
+            )
         )
-    local, lower, count, splits = partition_build_keys(
-        index_keys_sorted.astype(np.int32), n_shards
-    )
-    return (
-        jax.device_put(local.reshape(-1), rows),
-        jax.device_put(lower.reshape(-1), rows),
-        jax.device_put(count.reshape(-1), rows),
-        jax.device_put(splits, repl),
-    )
 
 
 def partitioned_probe(
@@ -594,14 +608,26 @@ def _retry_probe_device(mesh: Mesh, m: int, capacity: "int | None", launch):
     if capacity is None:
         capacity = _default_capacity(m, n_shards)
     padded_m = m + ((-m) % n_shards)
-    while True:
-        lo, ct, overflow = launch(capacity)
-        telemetry.count_sync(1)
-        if not bool(jax.device_get(overflow)):  # one O(1) scalar sync/attempt
-            return _renamed_rows(mesh, lo), _renamed_rows(mesh, ct)
-        if capacity >= max(padded_m, 1):
-            raise RuntimeError("partitioned probe: capacity overflow at maximum")
-        capacity *= 2
+    retries = 0
+    # the exchange stage covers the whole shard_map launch: all_to_all
+    # key shuffle + per-shard local probe + answer return + hot merge
+    # (one fused SPMD executable, not separable from outside)
+    with telemetry.stage("join:all_to_all", m) as _x:
+        while True:
+            lo, ct, overflow = launch(capacity)
+            telemetry.count_sync(1)
+            if not bool(jax.device_get(overflow)):  # one O(1) scalar sync/attempt
+                _x["capacity"] = capacity
+                _x["retries"] = retries
+                out = _renamed_rows(mesh, lo), _renamed_rows(mesh, ct)
+                telemetry.barrier(out)
+                return out
+            if capacity >= max(padded_m, 1):
+                raise RuntimeError(
+                    "partitioned probe: capacity overflow at maximum"
+                )
+            capacity *= 2
+            retries += 1
 
 
 def partitioned_probe_device(

@@ -111,35 +111,118 @@ def fnv1a_lanes_device(lane_arrays):
     return h
 
 
-def fnv1a_affix_int_device(prefix: bytes, values) -> "object":
-    """32-bit FNV-1a per ROW of a typed affix-int32 column, computed ON
-    DEVICE from the value lanes — byte-identical to :func:`fnv1a_values`
-    over ``prefix + decimal(value)``, with no formatting and no
-    dictionary (typed columns have neither).  The constant prefix folds
-    into the seed on host; the per-row part hashes an optional '-' and
-    the up-to-10 decimal digits MSB-first via pow10 gathers."""
+def _affix_rows_ops(h0, v):
+    """Per-row FNV-1a of ``prefix + decimal(value)`` from the seed
+    ``h0`` (the prefix folded on host).  Plain jnp ops: callable
+    EAGERLY (each pass dispatches on its own, preserving the input's
+    sharding — the mesh path needs this, see checksum_device_table) or
+    under jit (the single-device path fuses it, see _jit_kernels)."""
     import jax.numpy as jnp
 
-    h0 = int(_FNV_OFFSET)
-    for b in prefix:
-        h0 = ((h0 ^ b) * int(_FNV_PRIME)) & 0xFFFFFFFF
-    v = jnp.asarray(values)
     neg = v < 0
-    av = jnp.where(neg, -v, v)  # |v| <= 2^31-1 (parser rejects INT32_MIN)
-    h = jnp.full(v.shape, jnp.uint32(h0))
+    av = jnp.where(neg, -v, v)  # |v| <= 2^31-1 (no INT32_MIN cells)
+    h = jnp.full(v.shape, h0)
     h = jnp.where(neg, (h ^ jnp.uint32(ord("-"))) * jnp.uint32(_FNV_PRIME), h)
+    del neg  # eagerly this chain's live set IS the RSS peak at 100M
     pow10 = jnp.asarray([10**k for k in range(10)], dtype=jnp.int32)
     nd = jnp.ones(v.shape, jnp.int32)
     for k in range(1, 10):
         nd = nd + (av >= pow10[k]).astype(jnp.int32)
     for i in range(10):
-        e = jnp.clip(nd - 1 - i, 0, 9)
-        p = jnp.take(pow10, e, axis=0)
-        digit = (av // p) % 10
-        byte = (jnp.uint32(ord("0")) + digit.astype(jnp.uint32))
-        active = i < nd
-        h = jnp.where(active, (h ^ byte) * jnp.uint32(_FNV_PRIME), h)
+        # one nested expression per digit: its temporaries die as the
+        # enclosing op consumes them instead of persisting as locals
+        byte = jnp.uint32(ord("0")) + (
+            (av // jnp.take(pow10, jnp.clip(nd - 1 - i, 0, 9), axis=0)) % 10
+        ).astype(jnp.uint32)
+        h = jnp.where(i < nd, (h ^ byte) * jnp.uint32(_FNV_PRIME), h)
+        del byte
     return h
+
+
+def _dict_rows_ops(htab, codes):
+    """Per-row hash via dictionary-hash-table gather; absent cells
+    (code < 0) contribute 0.  Eager- and jit-callable like
+    :func:`_affix_rows_ops`."""
+    import jax.numpy as jnp
+
+    g = jnp.take(htab, jnp.clip(codes, 0), axis=0)
+    return jnp.where(codes >= 0, g, jnp.uint32(0))
+
+
+def _pos_weights(n):
+    import jax.numpy as jnp
+
+    return 2 * jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(1)
+
+
+_JIT_KERNELS: dict = {}
+
+
+def _jit_kernels() -> dict:
+    """Jitted checksum kernels, built lazily (module import stays
+    jax-free).  Fusing matters at scale, for memory before speed: the
+    eager affix hash chain is ~30 unfused element-wise passes holding
+    several full-column intermediates alive at once (~2GB of transient
+    host RSS at 100M rows on the CPU backend, the same disease as the
+    r06 probe-translate regression); the fused kernels stream them and
+    return one scalar per column.  SINGLE-DEVICE columns only: on the
+    virtual 8-device mesh these fused programs regressed peak host RSS
+    ~1.6x at 100M rows (measured 7.2GB eager -> 11.8GB fused, with the
+    positional weights as traced iota and no input slicing), so
+    mesh-sharded columns keep the eager per-op chain whose every pass
+    demonstrably preserves the input's sharding."""
+    if _JIT_KERNELS:
+        return _JIT_KERNELS
+    import jax
+    import jax.numpy as jnp
+
+    _JIT_KERNELS.update(
+        affix_rows=jax.jit(_affix_rows_ops),
+        affix_sum=jax.jit(
+            lambda h0, v: jnp.sum(_affix_rows_ops(h0, v), dtype=jnp.uint32)
+        ),
+        affix_wsum=jax.jit(
+            lambda h0, v: jnp.sum(
+                _affix_rows_ops(h0, v) * _pos_weights(v.shape[0]),
+                dtype=jnp.uint32,
+            )
+        ),
+        dict_sum=jax.jit(
+            lambda htab, codes: jnp.sum(
+                _dict_rows_ops(htab, codes), dtype=jnp.uint32
+            )
+        ),
+        dict_wsum=jax.jit(
+            lambda htab, codes: jnp.sum(
+                _dict_rows_ops(htab, codes) * _pos_weights(codes.shape[0]),
+                dtype=jnp.uint32,
+            )
+        ),
+    )
+    return _JIT_KERNELS
+
+
+def _affix_seed(prefix: bytes) -> int:
+    h0 = int(_FNV_OFFSET)
+    for b in prefix:
+        h0 = ((h0 ^ b) * int(_FNV_PRIME)) & 0xFFFFFFFF
+    return h0
+
+
+def fnv1a_affix_int_device(prefix: bytes, values) -> "object":
+    """32-bit FNV-1a per ROW of a typed affix-int32 column, computed ON
+    DEVICE from the value lanes — byte-identical to :func:`fnv1a_values`
+    over ``prefix + decimal(value)``, with no formatting and no
+    dictionary (typed columns have neither).  The constant prefix folds
+    into the seed on host (passed traced, so every prefix shares one
+    executable); the per-row part hashes an optional '-' and the
+    up-to-10 decimal digits MSB-first via pow10 gathers, fused in one
+    jitted kernel."""
+    import jax.numpy as jnp
+
+    return _jit_kernels()["affix_rows"](
+        jnp.uint32(_affix_seed(prefix)), jnp.asarray(values)
+    )
 
 
 def checksum_device_table(
@@ -159,26 +242,70 @@ def checksum_device_table(
 
     names = list(columns) if columns is not None else list(table.columns)
     n = table.nrows if limit is None else min(limit, table.nrows)
-    weights = (
-        2 * jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(1) if positional else None
-    )
+    # full-table checksums must NOT slice: an eager [:n] on a mesh-
+    # sharded array (even the no-op n == nrows) re-materializes it
+    # outside its sharding, and positional weights are iota-generated
+    # inside the jitted kernels for the same reason (see _jit_kernels)
+    full = n == table.nrows
     # mesh-sharded tables: each column's reduction lowers to a cross-
     # device all-reduce; concurrent eagerly-dispatched collective
     # programs can race the XLA:CPU rendezvous (observed: 7-of-8
-    # participants, hard abort), so their scalars sync one at a time
+    # participants, hard abort), so their scalars sync one at a time.
+    # The sharded path also stays EAGER per op — the fused jitted
+    # kernels regressed peak host RSS ~1.6x at 100M mesh rows (see
+    # _jit_kernels) — while single-device columns take the fused
+    # kernels for their ~2GB-smaller transient footprint.
     serialize = any(
         len(getattr(table.columns[c].storage, "sharding", None).device_set) > 1
         if getattr(table.columns[c].storage, "sharding", None) is not None
         else False
         for c in names
     )
+    kernels = None if serialize else _jit_kernels()
+    # eager path: one weights buffer for the whole table, PLACED WITH
+    # the hash array's own sharding (a mismatched operand would make
+    # GSPMD gather the sharded side), and the weighted reduce as a
+    # single dot — uint32 dot wraps mod 2^32 like the summed product
+    # but never materializes the 400MB hash*weight array at 100M rows
+    w_host = (
+        2 * np.arange(n, dtype=np.uint32) + np.uint32(1)
+        if serialize and positional
+        else None
+    )
+    w_cache: dict = {}
+
+    def _eager_wsum(hashes):
+        from jax import lax
+
+        if w_host is None:
+            return jnp.sum(hashes, dtype=jnp.uint32)
+        w = w_cache.get(hashes.sharding)
+        if w is None:
+            w = jax.device_put(w_host, hashes.sharding)
+            w_cache[hashes.sharding] = w
+        return lax.dot_general(
+            hashes,
+            w,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.uint32,
+        )
+
     sums = []
     for c in names:
         col = table.columns[c]
         if getattr(col, "kind", "str") == "int":
             # typed value lanes hash per row directly (no dictionary,
             # no demotion); all cells present by the typed invariant
-            gathered = fnv1a_affix_int_device(col.prefix, col.values[:n])
+            seed = jnp.uint32(_affix_seed(col.prefix))
+            vals = col.values if full else col.values[:n]
+            if kernels is not None:
+                s = (
+                    kernels["affix_wsum"](seed, vals)
+                    if positional
+                    else kernels["affix_sum"](seed, vals)
+                )
+            else:
+                s = _eager_wsum(_affix_rows_ops(seed, vals))
         else:
             if (
                 getattr(col, "dev_dictionary", None) is not None
@@ -189,12 +316,15 @@ def checksum_device_table(
                 htab = jax.device_put(
                     fnv1a_values(col.dictionary).astype(jnp.uint32)
                 )
-            codes = col.codes[:n]
-            gathered = jnp.take(htab, jnp.clip(codes, 0), axis=0)
-            gathered = jnp.where(codes >= 0, gathered, jnp.uint32(0))
-        if weights is not None:
-            gathered = gathered * weights
-        s = jnp.sum(gathered, dtype=jnp.uint32)
+            codes = col.codes if full else col.codes[:n]
+            if kernels is not None:
+                s = (
+                    kernels["dict_wsum"](htab, codes)
+                    if positional
+                    else kernels["dict_sum"](htab, codes)
+                )
+            else:
+                s = _eager_wsum(_dict_rows_ops(htab, codes))
         sums.append(np.uint32(s) if serialize else s)
     if serialize:
         return {c: int(v) for c, v in zip(names, sums)}

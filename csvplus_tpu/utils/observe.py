@@ -109,6 +109,59 @@ class Telemetry:
             )
         )
 
+    def barrier(self, x):
+        """``jax.block_until_ready(x)`` when collecting, so async device
+        work lands inside the stage that dispatched it and per-stage
+        times are attributable.  A no-op (and zero dispatch-overlap
+        cost) when collection is off — headline timings are measured
+        with telemetry disabled, the per-stage table with it enabled."""
+        if self.enabled and x is not None:
+            import jax
+
+            jax.block_until_ready(x)
+        return x
+
+    def add_stage(
+        self, name: str, rows_in: int, rows_out: int, seconds: float, **extra
+    ) -> None:
+        """Record a PRE-MEASURED stage — for work accumulated across many
+        small slices (e.g. per-chunk producer waits or per-shard seals in
+        the streaming ingest) where a contextmanager per slice would
+        drown the measurement in bookkeeping.  One record per call."""
+        if not self.enabled:
+            return
+        self.records.append(
+            StageRecord(
+                stage=name,
+                rows_in=int(rows_in),
+                rows_out=int(rows_out),
+                seconds=float(seconds),
+                extra=extra,
+            )
+        )
+
+    def merged_stages(self) -> List[StageRecord]:
+        """Records merged by stage name (first-seen order): seconds and
+        row counts summed, extras taken from the last record of the
+        name.  This is the per-stage table shape the bench artifacts
+        carry — a 3-join pipeline records e.g. 'join:translate' once per
+        join, but the artifact wants one line per stage kind."""
+        order: List[str] = []
+        merged: Dict[str, StageRecord] = {}
+        for r in self.records:
+            got = merged.get(r.stage)
+            if got is None:
+                order.append(r.stage)
+                merged[r.stage] = StageRecord(
+                    r.stage, r.rows_in, r.rows_out, r.seconds, dict(r.extra)
+                )
+            else:
+                got.rows_in += r.rows_in
+                got.rows_out += r.rows_out
+                got.seconds += r.seconds
+                got.extra.update(r.extra)
+        return [merged[name] for name in order]
+
     def report(self) -> str:
         head = f"{'stage':<24} {'rows in':>12}    {'rows out':<12} {'time':>9}"
         return "\n".join([head] + [str(r) for r in self.records])

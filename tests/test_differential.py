@@ -310,6 +310,95 @@ def test_random_pipeline_typed_ingest_matches_host(spec, pipeline):
         os.unlink(path)
 
 
+def _needs_mesh():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+
+
+@given(typed_csv_rows(max_rows=24), st.lists(stages(), min_size=0, max_size=3))
+def test_random_pipeline_sharded_ingest_matches_host(spec, pipeline):
+    """Mesh-sharded STREAMED-INGEST origin (the table-origin vocabulary
+    gap VERDICT #3 flagged): the CSV streams chunk-by-chunk onto an
+    8-shard mesh — tiny chunks, so shard boundaries land mid-file and
+    typed columns exercise the per-shard seal — and every random
+    pipeline must match the host oracle, INCLUDING the n=0 header-only
+    table (which reaches the mesh through the whole-file fallback)."""
+    import os
+    import tempfile
+
+    from csvplus_tpu import from_file
+
+    _needs_mesh()
+    env = {"CSVPLUS_STREAM_MIN_BYTES": "1", "CSVPLUS_STREAM_CHUNK_BYTES": "96"}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    fd, path = tempfile.mkstemp(suffix=".csv")
+    os.close(fd)
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("a,b\n")
+            f.writelines(f"{x},{y}\n" for x, y in spec)
+        host = run_either(Take(from_file(path)), pipeline)
+        dev_src = apply_stages(
+            from_file(path).on_device("cpu", shards=8), pipeline
+        )
+        dev = run_either(dev_src, [])
+        check_verifier_verdicts(getattr(dev_src, "plan", None), host, dev)
+        if host[0] == "rows":
+            assert dev == host
+        else:
+            assert dev[0] == "error"
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        os.unlink(path)
+
+
+def test_sharded_fixed_examples_including_empty(tmp_path, monkeypatch):
+    """Deterministic floor for the mesh-sharded origins: EMPTY tables
+    (both a 0-row with_sharding table and a header-only sharded-ingest
+    file), a 1-row table (7 of 8 shards all-padding), and a table larger
+    than the shard count, through the fixed pipeline vocabulary."""
+    from csvplus_tpu import from_file
+    from csvplus_tpu.parallel.mesh import make_mesh
+
+    _needs_mesh()
+    mesh = make_mesh(8)
+    for rows in [[], [Row({"a": "x", "b": "y"})], _FIXED_TABLES[2]]:
+        for pipeline in _FIXED_PIPELINES:
+            host = run_either(take_rows(rows), pipeline)
+            table = DeviceTable.from_rows(rows, device="cpu").with_sharding(mesh)
+            dev_src = apply_stages(source_from_table(table), pipeline)
+            dev = run_either(dev_src, [])
+            check_verifier_verdicts(getattr(dev_src, "plan", None), host, dev)
+            if host[0] == "rows":
+                assert dev == host, (rows, pipeline)
+            else:
+                assert dev[0] == "error", (rows, pipeline)
+
+    monkeypatch.setenv("CSVPLUS_STREAM_MIN_BYTES", "1")
+    monkeypatch.setenv("CSVPLUS_STREAM_CHUNK_BYTES", "64")
+    for body in ["", "7,alpha\n", "".join(f"{i % 10},w{i % 3}\n" for i in range(64))]:
+        p = tmp_path / f"m{len(body)}.csv"
+        p.write_text("a,b\n" + body)
+        for pipeline in _FIXED_PIPELINES:
+            host = run_either(Take(from_file(str(p))), pipeline)
+            dev_src = apply_stages(
+                from_file(str(p)).on_device("cpu", shards=8), pipeline
+            )
+            dev = run_either(dev_src, [])
+            check_verifier_verdicts(getattr(dev_src, "plan", None), host, dev)
+            if host[0] == "rows":
+                assert dev == host, (body[:16], pipeline)
+            else:
+                assert dev[0] == "error", (body[:16], pipeline)
+
+
 _FIXED_TABLES = [
     [],
     [Row({"a": "x", "b": "y", "c": "zz"})],
