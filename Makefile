@@ -1,7 +1,7 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro bench-mesh dryrun example coldcheck lint
+.PHONY: test soak bench bench-micro bench-mesh bench-ingest dryrun example coldcheck lint
 
 test:
 	python -m pytest tests/ -x -q
@@ -40,6 +40,16 @@ bench-micro:
 #   CSVPLUS_BENCH_MESH_ROWS=100000000 make bench-mesh
 bench-mesh:
 	python bench.py --bench-mesh
+
+# Streamed-ingest gate (10M rows by default): runs the staged
+# multi-worker ingest pipeline at workers=1 and workers=auto over the
+# same file, requires bitwise-equal full-result checksums, prints one
+# JSON line with the auto-worker ingest rows/s; exits nonzero on a >2x
+# regression vs bench_ingest_floor.json.  The checked-in record
+# artifact (BENCH_INGEST_r07.json) is only (re)written when
+# CSVPLUS_BENCH_INGEST_OUT is set.
+bench-ingest:
+	JAX_PLATFORMS=cpu python bench.py --bench-ingest
 
 dryrun:
 	python __graft_entry__.py

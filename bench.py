@@ -1228,6 +1228,215 @@ def _bench_mesh() -> int:
     return 0
 
 
+def _bench_ingest() -> int:
+    """The `make bench-ingest` tier: streamed CSV ingest through the
+    staged multi-worker pipeline, with the same floor contract as the
+    other gate tiers (fails when the measured rate drops under half
+    the checked-in floor in bench_ingest_floor.json).
+
+    Two in-process runs over the SAME cached orders file, both forced
+    onto the chunk-streamed tier: CSVPLUS_INGEST_WORKERS=1 (the serial
+    degenerate case of the staged pipeline) and the auto worker count.
+    Full-result positional per-column checksums of the two device
+    tables must be bitwise-equal — worker count must be unobservable
+    in the output — or the tier fails regardless of speed.
+
+    Record-or-postmortem contract (mirroring bench-mesh): the artifact
+    either records a >=2x parallel speedup over serial or carries the
+    postmortem evidence that this host cannot show one (host_cpus,
+    the resolved auto worker count, and the speedup actually seen).
+    The per-stage worker table (ingest:cut / ingest:encode /
+    ingest:reorder-stall with per-worker busy seconds) from
+    telemetry.merged_stages() is embedded per run.
+
+    Env knobs: CSVPLUS_BENCH_INGEST_ROWS (default 10M — the gate
+    tier), CSVPLUS_BENCH_INGEST_OUT (artifact path; no file by
+    default so a gate run cannot overwrite the checked-in record)."""
+    import gc
+    import resource
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    rows = int(os.environ.get("CSVPLUS_BENCH_INGEST_ROWS", 10_000_000))
+    out_path = os.environ.get("CSVPLUS_BENCH_INGEST_OUT")
+    # force the chunk-streamed tier even when the file is under the
+    # 256MB default threshold (the 10M-row orders file is borderline)
+    os.environ.setdefault("CSVPLUS_STREAM_MIN_BYTES", "1000000")
+
+    sys.path.insert(0, repo)
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_northstar_gen", os.path.join(repo, "examples", "northstar.py")
+    )
+    gen_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen_mod)
+    opath = gen_mod.generate(rows)
+    sys.stderr.write(
+        f"bench[ingest]: orders file {opath}"
+        f" ({os.path.getsize(opath) / 1e9:.2f} GB)\n"
+    )
+
+    import jax
+
+    from csvplus_tpu import FromFile
+    from csvplus_tpu.native.scanner import _ingest_workers
+    from csvplus_tpu.utils.checksum import checksum_device_table
+    from csvplus_tpu.utils.observe import telemetry
+
+    backend = jax.default_backend()
+    host_cpus = os.cpu_count() or 1
+
+    def _run(workers_env):
+        if workers_env is None:
+            os.environ.pop("CSVPLUS_INGEST_WORKERS", None)
+        else:
+            os.environ["CSVPLUS_INGEST_WORKERS"] = str(workers_env)
+        with telemetry.collect():
+            t0 = time.perf_counter()
+            pipe = FromFile(opath).OnDevice()
+            pipe.plan.table.sync()
+            dt = time.perf_counter() - t0
+            stages = [
+                {
+                    "stage": r.stage,
+                    "rows_in": r.rows_in,
+                    "rows_out": r.rows_out,
+                    "seconds": round(r.seconds, 4),
+                    **{
+                        k: (round(v, 4) if isinstance(v, float) else v)
+                        for k, v in r.extra.items()
+                    },
+                }
+                for r in telemetry.merged_stages()
+                if r.stage.startswith("ingest")
+            ]
+        table = pipe.plan.table
+        cols = sorted(table.columns)
+        sums = checksum_device_table(table, cols, positional=True)
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+        del pipe, table
+        gc.collect()
+        return dt, sums, stages, rss
+
+    try:
+        _run(1)  # warmup: pay the one-time XLA compiles outside the clock
+        t_serial, sums_serial, stages_serial, rss_serial = _run(1)
+        k_auto = _ingest_workers()
+        t_auto, sums_auto, stages_auto, rss_peak = _run(None)
+    except Exception as e:
+        sys.stderr.write(f"bench[ingest] FAILED: {type(e).__name__}: {e}\n")
+        return 1
+    serial_rate = rows / t_serial
+    auto_rate = rows / t_auto
+    speedup = auto_rate / serial_rate
+
+    if sums_auto != sums_serial:
+        sys.stderr.write(
+            "bench[ingest] FAILED: worker count is OBSERVABLE — checksums"
+            f" diverge between workers=1 and workers={k_auto}:"
+            f" {sums_serial} != {sums_auto}\n"
+        )
+        return 1
+    sys.stderr.write(
+        f"bench[ingest]: checksums bitwise-equal across workers=1 and"
+        f" workers={k_auto} ({len(sums_serial)} columns)\n"
+    )
+
+    record = {
+        "metric": "stream_ingest_parallel",
+        "rows": rows,
+        "backend": backend,
+        "value": round(auto_rate, 1),
+        "unit": "rows/s",
+        "serial_rows_per_sec": round(serial_rate, 1),
+        "speedup_vs_serial": round(speedup, 3),
+        "workers": k_auto,
+        "host_cpus": host_cpus,
+        "peak_host_rss_mb": round(rss_peak, 1),
+        "serial_rss_mb": round(rss_serial, 1),
+        "full_result_checksums": sums_auto,
+        "stage_table_serial": stages_serial,
+        "stage_table_auto": stages_auto,
+    }
+    if speedup < 2.0:
+        if host_cpus < 2:
+            record["parallelism_evidence"] = {
+                "note": (
+                    "postmortem: this host exposes a single CPU, so the"
+                    " auto worker count resolves to 1 and no parallel"
+                    " speedup is observable here; the >=2x target needs"
+                    " a multi-core host (workers scale via"
+                    " CSVPLUS_INGEST_WORKERS)"
+                ),
+                "host_cpus": host_cpus,
+                "auto_workers": k_auto,
+            }
+        else:
+            record["parallelism_evidence"] = {
+                "note": (
+                    f"speedup {speedup:.2f}x on {host_cpus} cpus missed"
+                    " the 2x target — investigate reorder-stall vs"
+                    " encode seconds in stage_table_auto"
+                ),
+                "host_cpus": host_cpus,
+                "auto_workers": k_auto,
+            }
+    try:
+        record["commit"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, cwd=repo, timeout=10,
+        ).stdout.strip() or None
+    except Exception:
+        pass
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+        sys.stderr.write(f"bench[ingest]: artifact written to {out_path}\n")
+
+    floor = 0.0
+    floor_rows = None
+    try:
+        with open(os.path.join(repo, "bench_ingest_floor.json")) as f:
+            fl = json.load(f)
+            floor = float(fl.get("ingest_rows_per_sec", 0.0))
+            floor_rows = fl.get("rows")
+    except (OSError, ValueError):
+        pass
+    print(
+        json.dumps(
+            {
+                "metric": "stream_ingest_parallel",
+                "rows": rows,
+                "value": round(auto_rate, 1),
+                "unit": "rows/s",
+                "serial_rows_per_sec": round(serial_rate, 1),
+                "speedup_vs_serial": round(speedup, 3),
+                "workers": k_auto,
+                "host_cpus": host_cpus,
+                "peak_host_rss_mb": round(rss_peak, 1),
+                "backend": backend,
+                "floor": floor,
+            }
+        ),
+        flush=True,
+    )
+    if floor and auto_rate < floor / 2:
+        sys.stderr.write(
+            f"bench[ingest] REGRESSION: streamed ingest {auto_rate:,.0f}"
+            f" rows/s is under half the floor ({floor:,.0f} rows/s at"
+            f" {floor_rows or '?'} rows)\n"
+        )
+        return 1
+    sys.stderr.write(
+        f"bench[ingest] ok: {auto_rate:,.0f} rows/s with workers={k_auto}"
+        f" (serial {serial_rate:,.0f} rows/s, {speedup:.2f}x,"
+        f" floor {floor:,.0f}) | rss {rss_peak:,.0f} MB (n={rows})\n"
+    )
+    return 0
+
+
 def _secondary_metrics(n_orders: int) -> None:
     """Informational numbers for the other BASELINE configs, to stderr
     (the driver contract is ONE json line on stdout)."""
@@ -1322,4 +1531,8 @@ if __name__ == "__main__":
         # the mesh child re-execs itself into the 8-device env; this
         # parent only probes, parses, and gates — no jax import needed
         sys.exit(_bench_mesh())
+    if "--bench-ingest" in sys.argv:
+        # host-side streamed-ingest tier: hermetic CPU, no mesh needed
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.exit(_bench_ingest())
     main()

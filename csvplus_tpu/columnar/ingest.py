@@ -23,13 +23,9 @@ from ..source import DataSource
 from .table import DeviceTable
 
 
-def _env_int(name: str, default: int) -> int:
-    """An int env knob; malformed values degrade to the default (never
-    abort an ingest over a typo'd tuning variable)."""
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
+# shared with the native scanner (utils.env); the old name stays an
+# alias because tests and downstream callers patch ingest._env_int
+from ..utils.env import env_int as _env_int
 
 
 
@@ -451,9 +447,18 @@ def _stream_to_table(reader, path: str, device, mesh=None) -> DeviceTable:
 
         raise StreamFallback("empty file")
 
+    from ..native.scanner import _ingest_workers
     from ..utils.observe import telemetry
 
-    telemetry.add_stage("ingest:scan", nrows, nrows, t_wait)
+    # scan-wait is the producer time NOT hidden by the staged pipeline
+    # (readahead + K chunk workers + ordered reassembly live inside the
+    # generator; its own ingest:cut/encode/reorder-stall records carry
+    # the per-worker attribution)
+    telemetry.add_stage(
+        "ingest:scan", nrows, nrows, t_wait,
+        workers=(1 if encoder is not None else _ingest_workers()),
+        prefetch=prefetch_depth,
+    )
     telemetry.add_stage("ingest:place", nrows, nrows, t_place)
 
     if shard_devs is not None:

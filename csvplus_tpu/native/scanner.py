@@ -17,12 +17,14 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..csvio import ERR_BARE_QUOTE, ERR_FIELD_COUNT, ERR_QUOTE
 from ..errors import DataSourceError
+from ..utils.env import env_int as _env_int
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "scanner.cpp")
@@ -336,7 +338,11 @@ def scan_bytes_parallel(
     offset-shifted concatenation.
     """
     n = len(data)
-    k = min(n_threads or os.cpu_count() or 1, 16)
+    # the thread cap is env-tunable so intra-chunk scan threads and
+    # ingest chunk workers (CSVPLUS_INGEST_WORKERS) can be balanced on
+    # the bench host instead of both oversubscribing every core
+    cap = _env_int("CSVPLUS_SCAN_THREADS", 16)
+    k = min(n_threads or os.cpu_count() or 1, cap)
     if n < _PARALLEL_MIN_BYTES or k < 2 or b'"' in data:
         return scan_bytes(data, delimiter, comment, lazy_quotes)
 
@@ -1059,8 +1065,334 @@ def _stream_chunk_bytes() -> int:
     return int(v) if v else _STREAM_CHUNK_BYTES
 
 
+def _ingest_workers() -> int:
+    """K for the staged chunk scan+encode pipeline
+    (``CSVPLUS_INGEST_WORKERS``).  0/unset = auto: half the cores — the
+    native scan threads *within* a chunk too, so chunk-level and
+    intra-chunk parallelism split the machine — capped at 8.  1 is the
+    serial degenerate case (same code path, driven inline)."""
+    k = _env_int("CSVPLUS_INGEST_WORKERS", 0)
+    if k <= 0:
+        k = min(max((os.cpu_count() or 1) // 2, 1), 8)
+    return max(1, min(k, 32))
+
+
+def _iter_parity_chunks(reader, f, chunk_bytes: int):
+    """Readahead stage: cut the file into newline/quote-parity-aligned
+    chunks.  Every chunk starts at a record boundary with closed quote
+    state (cumulative-quote-parity cut; the pending tail's parity and
+    quote presence carry across reads so each byte is parity-scanned
+    once).  Pure byte cutting — no scanning or encoding — so the staged
+    pipeline's workers all see boundary-exact chunks regardless of K."""
+    pending = b""
+    pend_parity = 0
+    pend_quote = False
+    eof = False
+    while not eof:
+        raw = f.read(chunk_bytes)
+        if not raw:
+            eof = True
+            data, pending = pending, b""
+            pend_parity, pend_quote = 0, False
+            if not data:
+                break
+        else:
+            raw_quote = b'"' in raw
+            if raw_quote or pend_quote:
+                if reader._lazy_quotes:
+                    # a bare quote inside an unquoted field is legal
+                    # under LazyQuotes and breaks the parity cut
+                    raise StreamFallback("quote under LazyQuotes")
+                # safe cut = last newline whose cumulative quote count
+                # is even (strict quoting: odd parity means the newline
+                # sits inside an open quoted field); only the NEW bytes
+                # are scanned, seeded with the pending tail's parity
+                a = np.frombuffer(raw, dtype=np.uint8)
+                parity = (
+                    np.cumsum(a == ord('"'), dtype=np.int64) + pend_parity
+                ) & 1
+                safe_nl = np.flatnonzero((a == ord("\n")) & (parity == 0))
+                if safe_nl.size == 0:
+                    pending += raw  # giant quoted record: read more
+                    pend_parity = int(parity[-1])
+                    pend_quote = pend_quote or raw_quote
+                    continue
+                cut = int(safe_nl[-1]) + 1
+                data, pending = pending + raw[:cut], raw[cut:]
+                pend_parity = int(parity[-1])  # parity at cut is 0
+                pend_quote = b'"' in pending
+            else:
+                cut = raw.rfind(b"\n") + 1
+                if cut == 0:
+                    pending += raw  # no record boundary yet
+                    continue
+                data, pending = pending + raw[:cut], raw[cut:]
+        yield data
+
+
+class _StreamCtx:
+    """Shared state the chunk workers read, established by the first
+    encoded chunk and owned by the ordered reassembler thereafter.
+
+    ``typed`` maps live typed columns to their PINNED prefix (None only
+    during the establishment chunk, where the prefix derives from the
+    first cell).  The reassembler swaps in a reduced dict when a column
+    demotes — workers read the attribute once per chunk, so an in-flight
+    worker may still encode a just-demoted column speculatively; the
+    reassembler normalizes that result, keeping the emitted stream
+    identical for every K."""
+
+    __slots__ = (
+        "reader",
+        "header",
+        "names",
+        "expected",
+        "pad_allowed",
+        "typed",
+        "fused_ncols",
+        "encoder",
+        "delim_b",
+        "scan_threads",
+    )
+
+    def __init__(self, reader, encoder):
+        self.reader = reader
+        self.encoder = encoder
+        self.header = None
+        self.names = []
+        self.expected = reader._num_fields
+        self.pad_allowed = reader._num_fields < 0
+        self.typed = {}
+        self.fused_ncols = 0
+        self.delim_b = reader._delimiter.encode("utf-8")
+        self.scan_threads = None
+
+
+class _ChunkResult:
+    """One chunk's scan+encode outcome, produced by a worker and
+    consumed in file order by the reassembler.  Errors are stored
+    CHUNK-RELATIVE (``absolute = rel + next_record - 1``) because only
+    the reassembler knows the chunk's absolute record base."""
+
+    __slots__ = ("nscanned", "nrec", "cols", "error", "t_scan", "t_encode", "worker")
+
+    def __init__(self):
+        self.nscanned = 0  # records scanned (header included on chunk 0)
+        self.nrec = 0  # data records
+        self.cols = None
+        self.error = None  # ("data", rel_record, msg) | ("fallback", reason)
+        self.t_scan = 0.0
+        self.t_encode = 0.0
+        self.worker = ""
+
+
+def _encode_scanned(
+    ctx, res, data, scratch, starts, lens, data_counts, field_offset, rec_base
+):
+    """Column encode over pre-scanned offset arrays — the single
+    implementation behind both the establishment chunk (prefix-derive
+    mode, inline) and the staged workers (pinned prefixes).  Fills
+    ``res`` in place; never raises for data-shaped problems (they land
+    in ``res.error``, chunk-relative)."""
+    reader = ctx.reader
+    header = ctx.header
+    typed = ctx.typed  # one read: the reassembler may swap in a new dict
+    # scratch holds unescaped quoted-field content; negative starts
+    # index it past the chunk (read_encoded_columns_native layout).
+    # Quote-free chunks skip the concatenation.
+    enc_data = data + scratch if scratch else data
+    combined = np.frombuffer(enc_data, dtype=np.uint8)
+    base = len(data)
+    abs_starts = (
+        np.where(starts >= 0, starts, base + (-starts - 1)) if scratch else starts
+    )
+    # RECTANGULAR fast path for typed columns: uniform field counts + no
+    # scratch means column idx of record r is flat field
+    # field_offset + r*nf + idx — the strided C++ parse reads it
+    # directly, skipping per-column position construction and gathers
+    typed_out = {}
+    failed_typed = set()
+    nrec = int(data_counts.shape[0])
+    res.nrec = nrec
+    uniform_nf = 0
+    if typed and not scratch and nrec:
+        mn, mx = int(data_counts.min()), int(data_counts.max())
+        if mn == mx:
+            uniform_nf = mn
+    if uniform_nf:
+        for name, idx in header.items():
+            prefix = typed.get(name, _NOT_TYPED)
+            if prefix is _NOT_TYPED or idx >= uniform_nf:
+                continue
+            packed = pack_int32_strided_native(
+                combined, starts, lens, nrec, uniform_nf, field_offset + idx, prefix
+            )
+            if packed is None:
+                failed_typed.add(name)  # dictionary from here; driver demotes
+                continue
+            typed_out[name] = ("int", packed[0], packed[1])
+
+    try:
+        cols = (
+            list(
+                _column_positions(
+                    data_counts, field_offset, header, rec_base, ctx.pad_allowed
+                )
+            )
+            if len(typed_out) < len(header)
+            else []
+        )
+    except DataSourceError as e:
+        res.error = ("data", int(e.line), e.err)
+        return
+    cols = [c for c in cols if c[0] not in typed_out]
+
+    def enc_one(args):
+        name, pos, ok = args
+        all_present = bool(ok.all())
+        if all_present:
+            col_starts, col_lens = abs_starts[pos], lens[pos].astype(np.int32)
+        else:
+            col_starts = np.where(ok, abs_starts[np.where(ok, pos, 0)], 0)
+            col_lens = np.where(ok, lens[np.where(ok, pos, 0)], 0).astype(np.int32)
+        prefix = typed.get(name, _NOT_TYPED)
+        if prefix is not _NOT_TYPED and name not in failed_typed:
+            # typed value-lane attempt; a padded/absent cell or a
+            # non-conforming field drops the column to dictionary mode —
+            # PERMANENTLY, but the demotion bookkeeping belongs to the
+            # reassembler (file order), not this worker
+            packed = (
+                pack_int32_native(combined, col_starts, col_lens, prefix)
+                if all_present
+                else None
+            )
+            if packed is not None:
+                return name, ("int", packed[0], packed[1])
+        enc = (
+            ctx.encoder(combined, enc_data, col_starts, col_lens)
+            if ctx.encoder is not None
+            else None
+        )
+        if enc is None:
+            enc = encode_fields_vectorized(combined, col_starts, col_lens)
+        if enc is None:
+            raise StreamFallback("field too long for vectorized encode")
+        return name, enc
+
+    try:
+        # device-encode chunks stay serial (one upload stream); host
+        # encodes thread across columns
+        out = dict(
+            [enc_one(c) for c in cols]
+            if ctx.encoder is not None
+            else _map_columns(enc_one, cols)
+        )
+    except StreamFallback as e:
+        res.error = ("fallback", str(e))
+        return
+    out.update(typed_out)
+    res.cols = out
+
+
+_NOT_TYPED = object()  # sentinel: None is a valid (derive-mode) prefix
+
+
+def _scan_encode_chunk(ctx, data):
+    """One staged worker's unit of work: scan + encode a single
+    post-establishment chunk against the immutable context.  Pure with
+    respect to shared state (reads ``ctx``, mutates nothing), so K
+    workers run it concurrently and the reassembler's file-order merge
+    is the only serialization point.  The native scan/pack/encode
+    helpers release the GIL, so the workers genuinely overlap."""
+    res = _ChunkResult()
+    res.worker = threading.current_thread().name
+    t0 = time.perf_counter()
+    reader = ctx.reader
+    if b"\x00" in data:
+        res.error = ("fallback", "NUL in chunk")
+        return res
+    typed = ctx.typed
+    # FUSED fast path: when every selected column is typed with an
+    # established prefix and the chunk is plain (no quotes/CR/comments),
+    # ONE C++ pass tokenizes and int-parses the whole chunk without
+    # writing field offsets at all — the two-pass scan+parse writes and
+    # re-reads ~12 bytes of offsets per field, which dominated the
+    # single-core 100M-row ingest profile.  Any bail (record arity,
+    # non-conforming cell) reruns the chunk through the generic path
+    # below, which owns exact error numbering.
+    if (
+        ctx.fused_ncols
+        and typed
+        # the fused C++ pass takes the delimiter as ONE char;
+        # multi-byte delimiters must take the generic path
+        and len(ctx.delim_b) == 1
+        and reader._comment is None
+        and len(typed) == len(ctx.header)
+        and all(
+            p is not None
+            # a prefix containing the delimiter or a record terminator
+            # (possible via quoted cells in earlier chunks) would let
+            # the fused parser's prefix memcmp read across field
+            # boundaries and misparse — those columns keep the
+            # tokenized path
+            and ctx.delim_b not in p
+            and b"\n" not in p
+            and b"\r" not in p
+            for p in typed.values()
+        )
+        and b'"' not in data
+        and b"\r" not in data
+    ):
+        fused = scan_parse_i32_native(
+            data,
+            reader._delimiter,
+            ctx.fused_ncols,
+            ctx.header,
+            {n: (p,) for n, p in typed.items()},
+        )
+        if fused is not None:
+            # fused records are structurally exact-arity, so the locked
+            # field-count policy holds by construction
+            nrec, typed_cols = fused
+            res.nscanned = nrec
+            res.nrec = nrec
+            res.cols = typed_cols
+            res.t_scan = time.perf_counter() - t0
+            return res
+    try:
+        # chunks start at record boundaries with closed quote state, so
+        # the multi-threaded newline-split scan applies to them exactly
+        # as to whole files (quote-bearing chunks fall back to the
+        # single-pass state machine inside)
+        starts, lens, counts, scratch = scan_bytes_parallel(
+            data,
+            delimiter=reader._delimiter,
+            comment=reader._comment,
+            lazy_quotes=reader._lazy_quotes,
+            n_threads=ctx.scan_threads,
+        )
+    except DataSourceError as e:
+        res.error = ("data", int(e.line), e.err)
+        return res
+    res.nscanned = int(counts.shape[0])
+    res.t_scan = time.perf_counter() - t0
+    if reader._num_fields >= 0:
+        try:
+            _check_field_counts(counts, ctx.expected, 1)
+        except DataSourceError as e:
+            res.error = ("data", int(e.line), e.err)
+            return res
+    _encode_scanned(ctx, res, data, scratch, starts, lens, counts, 0, 1)
+    res.t_encode = time.perf_counter() - t0 - res.t_scan
+    return res
+
+
 def stream_encoded_chunks(
-    reader, path: str, chunk_bytes: Optional[int] = None, encoder=None
+    reader,
+    path: str,
+    chunk_bytes: Optional[int] = None,
+    encoder=None,
+    workers: Optional[int] = None,
 ):
     """Generator over newline-aligned file chunks, each natively scanned
     and dictionary-encoded with zero per-cell Python objects.
@@ -1102,6 +1434,27 @@ def stream_encoded_chunks(
     pinned; the first non-conforming chunk switches the column to
     dictionary encoding permanently (the consumer re-encodes the
     accumulated chunks).  Disable with ``CSVPLUS_TYPED_LANES=0``.
+
+    STAGED PIPELINE (``CSVPLUS_INGEST_WORKERS``, or *workers*): after
+    the first chunk establishes the header, field-count policy, and
+    typed prefixes, the remaining chunks flow through a readahead stage
+    (:func:`_iter_parity_chunks`, parity-aligned byte cutting), a pool
+    of K workers running :func:`_scan_encode_chunk` concurrently (the
+    native scan/pack release the GIL), and an ordered reassembler that
+    emits chunks strictly in file order.  Workers encode typed lanes
+    SPECULATIVELY against an immutable prefix snapshot (the C++ parse
+    pins the prefix after derivation, so there is no per-chunk prefix
+    state to race on); the reassembler owns demotion — the first
+    non-conforming chunk IN FILE ORDER demotes a column regardless of
+    worker count or completion order, and any in-flight speculative
+    typed result for a demoted column is normalized to the identical
+    dictionary encoding.  Errors travel chunk-relative and are
+    re-numbered to absolute records at emission, so yields, error
+    numbers, and demotion points are bitwise-identical for every K;
+    K=1 drives the very same worker function inline (degenerate case,
+    no separate code path).  Host memory stays bounded: at most K
+    chunks in flight plus one being cut (plus the consumer's
+    ``CSVPLUS_STREAM_PREFETCH`` depth).
     """
     if reader._trim_leading_space:
         raise StreamFallback("trim")
@@ -1110,116 +1463,45 @@ def stream_encoded_chunks(
     if reader._comment is not None and len(reader._comment.encode("utf-8")) != 1:
         raise StreamFallback("comment")
     chunk_bytes = chunk_bytes or _stream_chunk_bytes()
+    k_workers = max(1, workers if workers is not None else _ingest_workers())
+    if encoder is not None:
+        k_workers = 1  # device-encode hook: one upload stream, stays inline
 
-    header = None
-    expected = reader._num_fields  # locked after the first record, Go csv.Reader style
-    pad_allowed = reader._num_fields < 0
-    next_record = 1  # absolute 1-based ordinal of the next record scanned
     typed_enabled = os.environ.get("CSVPLUS_TYPED_LANES", "1") != "0"
-    # per-column typed state: [prefix bytes | None] while eligible
-    # (None = derive from the first cell), absent key = dictionary mode
-    typed_state: "Dict[str, list]" = {}
-    fused_ncols = 0  # record arity for the fused pass (0 = ineligible)
+    next_record = 1  # absolute 1-based ordinal of the next record scanned
+    typed_live: set = set()  # columns still typed, in FILE order
+    _pc = time.perf_counter
+    stats = {
+        "cut": 0.0,  # readahead: file read + parity cut
+        "stall": 0.0,  # reassembler blocked on the head-of-line chunk
+        "scan": 0.0,
+        "encode": 0.0,
+        "rows": 0,
+        "chunks": 0,
+        "per_worker": {},
+    }
+
+    def account(res):
+        stats["chunks"] += 1
+        stats["rows"] += res.nrec
+        stats["scan"] += res.t_scan
+        stats["encode"] += res.t_encode
+        w = stats["per_worker"]
+        w[res.worker] = w.get(res.worker, 0.0) + res.t_scan + res.t_encode
 
     with open(path, "rb") as f:
-        pending = b""
-        # quote parity and quote presence of the pending tail are carried
-        # across reads so every byte is parity-scanned exactly once, even
-        # when a giant quoted record spans many chunk_bytes reads
-        pend_parity = 0
-        pend_quote = False
-        eof = False
-        while not eof:
-            raw = f.read(chunk_bytes)
-            if not raw:
-                eof = True
-                data, pending = pending, b""
-                pend_parity, pend_quote = 0, False
-                if not data:
-                    break
-            else:
-                raw_quote = b'"' in raw
-                if raw_quote or pend_quote:
-                    if reader._lazy_quotes:
-                        # a bare quote inside an unquoted field is legal
-                        # under LazyQuotes and breaks the parity cut
-                        raise StreamFallback("quote under LazyQuotes")
-                    # safe cut = last newline whose cumulative quote
-                    # count is even (strict quoting: odd parity means
-                    # the newline sits inside an open quoted field);
-                    # only the NEW bytes are scanned, seeded with the
-                    # pending tail's carried parity
-                    a = np.frombuffer(raw, dtype=np.uint8)
-                    parity = (
-                        np.cumsum(a == ord('"'), dtype=np.int64) + pend_parity
-                    ) & 1
-                    safe_nl = np.flatnonzero((a == ord("\n")) & (parity == 0))
-                    if safe_nl.size == 0:
-                        pending += raw  # giant quoted record: read more
-                        pend_parity = int(parity[-1])
-                        pend_quote = pend_quote or raw_quote
-                        continue
-                    cut = int(safe_nl[-1]) + 1
-                    data, pending = pending + raw[:cut], raw[cut:]
-                    pend_parity = int(parity[-1])  # parity at cut is 0
-                    pend_quote = b'"' in pending
-                else:
-                    cut = raw.rfind(b"\n") + 1
-                    if cut == 0:
-                        pending += raw  # no record boundary yet
-                        continue
-                    data, pending = pending + raw[:cut], raw[cut:]
+        chunks_iter = _iter_parity_chunks(reader, f, chunk_bytes)
+        ctx = None
+
+        # ---- establishment: inline until the first encoded chunk.
+        # Header resolution, field-count locking, and typed-prefix
+        # derivation all happen here, exactly as the whole-file tiers do;
+        # afterwards the context is immutable to workers. ----
+        for data in chunks_iter:
+            t0 = _pc()
             if b"\x00" in data:
                 raise StreamFallback("NUL in chunk")
-            # FUSED fast path (chunks after the first): when every
-            # selected column is typed with an established prefix and the
-            # chunk is plain (no quotes/CR/comments), ONE C++ pass
-            # tokenizes and int-parses the whole chunk without writing
-            # field offsets at all — the two-pass scan+parse writes and
-            # re-reads ~12 bytes of offsets per field, which dominated
-            # the single-core 100M-row ingest profile.  Any bail (record
-            # arity, non-conforming cell) reruns the chunk through the
-            # generic path below, which owns exact error numbering.
-            _delim_b = reader._delimiter.encode("utf-8")
-            if (
-                header is not None
-                and fused_ncols
-                and typed_state
-                # the fused C++ pass takes the delimiter as ONE char;
-                # multi-byte delimiters must take the generic path
-                and len(_delim_b) == 1
-                and reader._comment is None
-                and len(typed_state) == len(header)
-                and all(
-                    st[0] is not None
-                    # a prefix containing the delimiter or a record
-                    # terminator (possible via quoted cells in earlier
-                    # chunks) would let the fused parser's prefix memcmp
-                    # read across field boundaries and misparse — those
-                    # columns keep the tokenized path
-                    and _delim_b not in st[0]
-                    and b"\n" not in st[0]
-                    and b"\r" not in st[0]
-                    for st in typed_state.values()
-                )
-                and b'"' not in data
-                and b"\r" not in data
-            ):
-                fused = scan_parse_i32_native(
-                    data, reader._delimiter, fused_ncols, header, typed_state
-                )
-                if fused is not None:
-                    # fused records are structurally exact-arity, so the
-                    # locked field-count policy holds by construction
-                    nrec, typed_cols = fused
-                    next_record += nrec
-                    yield names, typed_cols, nrec
-                    continue
             try:
-                # chunks start at record boundaries with closed quote
-                # state, so the multi-threaded newline-split scan applies
-                # to them exactly as to whole files (quote-bearing chunks
-                # fall back to the single-pass state machine inside)
                 starts, lens, counts, scratch = scan_bytes_parallel(
                     data,
                     delimiter=reader._delimiter,
@@ -1228,132 +1510,180 @@ def stream_encoded_chunks(
                 )
             except DataSourceError as e:
                 raise DataSourceError(e.line + next_record - 1, e.err)
-            if header is None and counts.shape[0] == 0:
+            if counts.shape[0] == 0:
                 continue  # comment-only chunk before the first record
-            if header is None:
-                # first chunk with records: header + field-count policy
-                # resolve exactly as the whole-file tiers do
-                header, rec_base, field_offset, data_counts, expected = (
-                    _resolve_header_from_arrays(
-                        reader, data, scratch, starts, lens, counts
-                    )
-                )
-                names = list(header)
-                first_data_record = rec_base
-                if typed_enabled:
-                    typed_state = {n: [None] for n in names}
-                    if expected and expected > 0:
-                        fused_ncols = int(expected)
-                    elif data_counts.size and int(data_counts.min()) == int(
-                        data_counts.max()
-                    ):
-                        fused_ncols = int(data_counts[0])
-            else:
-                field_offset = 0
-                data_counts = counts
-                first_data_record = next_record
-                if reader._num_fields >= 0:
-                    expected = _check_field_counts(
-                        data_counts, expected, first_data_record
-                    )
-            next_record += int(counts.shape[0])
-
-            # scratch holds unescaped quoted-field content; negative
-            # starts index it past the chunk (read_encoded_columns_native
-            # layout).  Quote-free chunks skip the concatenation.
-            enc_data = data + scratch if scratch else data
-            combined = np.frombuffer(enc_data, dtype=np.uint8)
-            base = len(data)
-            abs_starts = (
-                np.where(starts >= 0, starts, base + (-starts - 1))
-                if scratch
-                else starts
+            # first chunk with records: header + field-count policy
+            # resolve exactly as the whole-file tiers do
+            header, rec_base, field_offset, data_counts, expected = (
+                _resolve_header_from_arrays(reader, data, scratch, starts, lens, counts)
             )
-            # RECTANGULAR fast path for typed columns: uniform field
-            # counts + no scratch means column idx of record r is flat
-            # field field_offset + r*nf + idx — the strided C++ parse
-            # reads it directly, skipping the per-column position-array
-            # construction and gathers (the single-core ingest profile's
-            # second-largest cost after the scan itself)
-            typed_out = {}
-            nrec = int(data_counts.shape[0])
-            uniform_nf = 0
-            if typed_state and not scratch and nrec:
-                mn, mx = int(data_counts.min()), int(data_counts.max())
-                if mn == mx:
-                    uniform_nf = mn
-            if uniform_nf:
-                for name, idx in header.items():
-                    st = typed_state.get(name)
-                    if st is None or idx >= uniform_nf:
-                        continue
-                    packed = pack_int32_strided_native(
-                        combined,
-                        starts,
-                        lens,
-                        nrec,
-                        uniform_nf,
-                        field_offset + idx,
-                        st[0],
-                    )
-                    if packed is None:
-                        typed_state.pop(name, None)
-                        continue
-                    st[0] = packed[0]
-                    typed_out[name] = ("int", packed[0], packed[1])
-
-            cols = list(
-                _column_positions(
-                    data_counts, field_offset, header, first_data_record, pad_allowed
-                )
-            ) if len(typed_out) < len(header) else []
-            cols = [c for c in cols if c[0] not in typed_out]
-
-            def enc_one(args):
-                name, pos, ok = args
-                all_present = bool(ok.all())
-                if all_present:
-                    col_starts, col_lens = abs_starts[pos], lens[pos].astype(np.int32)
-                else:
-                    col_starts = np.where(ok, abs_starts[np.where(ok, pos, 0)], 0)
-                    col_lens = np.where(ok, lens[np.where(ok, pos, 0)], 0).astype(
-                        np.int32
-                    )
-                st = typed_state.get(name)
-                if st is not None:
-                    # typed value-lane attempt; a padded/absent cell or a
-                    # non-conforming field drops the column to dictionary
-                    # mode for good (one flag write; chunks are
-                    # sequential and each column has one task per chunk)
-                    packed = (
-                        pack_int32_native(combined, col_starts, col_lens, st[0])
-                        if all_present
-                        else None
-                    )
-                    if packed is not None:
-                        st[0] = packed[0]
-                        return name, ("int", packed[0], packed[1])
-                    typed_state.pop(name, None)
-                enc = (
-                    encoder(combined, enc_data, col_starts, col_lens)
-                    if encoder is not None
-                    else None
-                )
-                if enc is None:
-                    enc = encode_fields_vectorized(combined, col_starts, col_lens)
-                if enc is None:
-                    raise StreamFallback("field too long for vectorized encode")
-                return name, enc
-
-            # device-encode chunks stay serial (one upload stream); host
-            # encodes thread across columns
-            out = dict(
-                [enc_one(c) for c in cols]
-                if encoder is not None
-                else _map_columns(enc_one, cols)
+            ctx = _StreamCtx(reader, encoder)
+            ctx.header = header
+            ctx.names = list(header)
+            ctx.expected = expected
+            if typed_enabled:
+                ctx.typed = {n: None for n in ctx.names}  # derive mode
+                if expected and expected > 0:
+                    ctx.fused_ncols = int(expected)
+                elif data_counts.size and int(data_counts.min()) == int(
+                    data_counts.max()
+                ):
+                    ctx.fused_ncols = int(data_counts[0])
+            if k_workers > 1:
+                # chunk-level and intra-chunk scan parallelism split the
+                # cores (each still subject to CSVPLUS_SCAN_THREADS)
+                ctx.scan_threads = max(1, (os.cpu_count() or 1) // k_workers)
+            res = _ChunkResult()
+            res.worker = threading.current_thread().name
+            res.nscanned = int(counts.shape[0])
+            res.t_scan = _pc() - t0
+            _encode_scanned(
+                ctx, res, data, scratch, starts, lens, data_counts,
+                field_offset, rec_base,
             )
-            out.update(typed_out)
-            yield names, out, nrec
+            res.t_encode = _pc() - t0 - res.t_scan
+            if res.error is not None:
+                if res.error[0] == "fallback":
+                    raise StreamFallback(res.error[1])
+                raise DataSourceError(res.error[1], res.error[2])  # next_record==1
+            # pin the derived prefixes; columns that came back as
+            # dictionaries left typed mode on their very first chunk
+            ctx.typed = {
+                c: enc[1]
+                for c, enc in res.cols.items()
+                if len(enc) == 3 and enc[0] == "int"
+            }
+            typed_live = set(ctx.typed)
+            account(res)
+            next_record += res.nscanned
+            yield ctx.names, res.cols, res.nrec
+            break
+        if ctx is None:
+            return  # no records at all: the consumer falls back
+
+        def emit(res):
+            """Ordered reassembly of one chunk result: translate errors
+            to absolute record numbers, apply demotions in file order,
+            normalize stale speculative typed results."""
+            nonlocal next_record
+            if res.error is not None:
+                if res.error[0] == "fallback":
+                    raise StreamFallback(res.error[1])
+                raise DataSourceError(res.error[1] + next_record - 1, res.error[2])
+            out = res.cols
+            demoted_now = False
+            for c in ctx.names:
+                enc = out[c]
+                if len(enc) == 3 and enc[0] == "int":
+                    if c not in typed_live:
+                        # speculative typed result from a worker whose
+                        # snapshot predates this column's demotion:
+                        # re-encode exactly as the consumer's late-typed
+                        # path does (format_affix is the exact inverse
+                        # of the native parse, so values — and therefore
+                        # the sorted dictionary and codes — are
+                        # bitwise-identical to a direct dictionary
+                        # encode of the raw bytes)
+                        from ..columnar.typed import format_affix
+
+                        strs = format_affix(enc[1], np.asarray(enc[2], np.int32))
+                        dd, cc = np.unique(strs, return_inverse=True)
+                        out[c] = (dd, cc.astype(np.int32))
+                elif c in typed_live:
+                    # first non-conforming chunk IN FILE ORDER: the
+                    # column leaves typed mode permanently, at the same
+                    # chunk index for every worker count
+                    typed_live.discard(c)
+                    demoted_now = True
+            if demoted_now:
+                # shrink the workers' snapshot so NEW chunks skip the
+                # dead speculative work (in-flight ones normalize above)
+                ctx.typed = {c: p for c, p in ctx.typed.items() if c in typed_live}
+            account(res)
+            next_record += res.nscanned
+            return ctx.names, out, res.nrec
+
+        # ---- staged phase: readahead -> K workers -> ordered emit ----
+        cut_error = None
+        if k_workers == 1:
+            # degenerate case: the same worker function, driven inline
+            while True:
+                t0 = _pc()
+                try:
+                    data = next(chunks_iter, None)
+                except StreamFallback as e:
+                    cut_error = e
+                    data = None
+                stats["cut"] += _pc() - t0
+                if data is None:
+                    break
+                yield emit(_scan_encode_chunk(ctx, data))
+        else:
+            from collections import deque
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = ThreadPoolExecutor(
+                max_workers=k_workers, thread_name_prefix="csvplus-ingest"
+            )
+            try:
+                pending: deque = deque()
+                exhausted = False
+                while True:
+                    # keep at most K chunks in flight: the host-memory
+                    # bound is K encodes + one chunk being cut
+                    while not exhausted and len(pending) < k_workers:
+                        t0 = _pc()
+                        try:
+                            data = next(chunks_iter, None)
+                        except StreamFallback as e:
+                            # the cutter hit input this tier cannot
+                            # chunk (quote under LazyQuotes): chunks
+                            # already cut still emit first, exactly as
+                            # the serial loop ordered them
+                            cut_error = e
+                            data = None
+                        stats["cut"] += _pc() - t0
+                        if data is None:
+                            exhausted = True
+                            break
+                        pending.append(pool.submit(_scan_encode_chunk, ctx, data))
+                    if not pending:
+                        break
+                    t0 = _pc()
+                    res = pending.popleft().result()
+                    stats["stall"] += _pc() - t0
+                    yield emit(res)
+            finally:
+                pool.shutdown(wait=False, cancel_futures=True)
+        if cut_error is not None:
+            raise cut_error
+
+    # per-stage attribution (collection-gated, pure accumulation — no
+    # barriers): cut = readahead read+parity, encode = worker busy time
+    # (sums across workers, so > wall clock when they overlap), stall =
+    # reassembler head-of-line waits
+    from ..utils.observe import telemetry
+
+    rows = stats["rows"]
+    telemetry.add_stage(
+        "ingest:cut", rows, rows, stats["cut"], chunks=stats["chunks"]
+    )
+    telemetry.add_stage(
+        "ingest:encode",
+        rows,
+        rows,
+        stats["scan"] + stats["encode"],
+        workers=k_workers,
+        scan_s=round(stats["scan"], 4),
+        encode_s=round(stats["encode"], 4),
+        per_worker_busy_s={
+            k: round(v, 4) for k, v in sorted(stats["per_worker"].items())
+        },
+    )
+    if k_workers > 1:
+        telemetry.add_stage(
+            "ingest:reorder-stall", rows, rows, stats["stall"], workers=k_workers
+        )
 
 
 def _scan_for_reader(reader, path: str):

@@ -1,0 +1,20 @@
+"""Shared environment-knob parsing.
+
+Lives in utils (not columnar.ingest) because both the native scanner
+and the columnar ingest read tuning knobs, and native must not import
+columnar (it would be a layering cycle: columnar.typed imports
+native.scanner).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def env_int(name: str, default: int) -> int:
+    """An int env knob; malformed values degrade to the default (never
+    abort an ingest over a typo'd tuning variable)."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default

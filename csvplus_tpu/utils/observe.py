@@ -142,8 +142,12 @@ class Telemetry:
 
     def merged_stages(self) -> List[StageRecord]:
         """Records merged by stage name (first-seen order): seconds and
-        row counts summed, extras taken from the last record of the
-        name.  This is the per-stage table shape the bench artifacts
+        row counts summed; ACCUMULABLE extras (keys ending in ``_s`` —
+        per-worker second tallies like the staged ingest's ``scan_s`` /
+        ``encode_s`` — and ``chunks``) sum too, all other extras taken
+        from the last record of the name (configuration-shaped values
+        like ``workers`` or ``max_shard_rows`` must not add across
+        records).  This is the per-stage table shape the bench artifacts
         carry — a 3-join pipeline records e.g. 'join:translate' once per
         join, but the artifact wants one line per stage kind."""
         order: List[str] = []
@@ -159,7 +163,16 @@ class Telemetry:
                 got.rows_in += r.rows_in
                 got.rows_out += r.rows_out
                 got.seconds += r.seconds
-                got.extra.update(r.extra)
+                for k, v in r.extra.items():
+                    old = got.extra.get(k)
+                    if (
+                        (k.endswith("_s") or k == "chunks")
+                        and isinstance(v, (int, float))
+                        and isinstance(old, (int, float))
+                    ):
+                        got.extra[k] = old + v
+                    else:
+                        got.extra[k] = v
         return [merged[name] for name in order]
 
     def report(self) -> str:

@@ -73,6 +73,7 @@ def main() -> None:
     import jax
 
     from csvplus_tpu import FromFile, Take
+    from csvplus_tpu.native.scanner import _ingest_workers
     from csvplus_tpu.utils.observe import telemetry
 
     assert len(jax.devices()) >= N_SHARDS, jax.devices()
@@ -237,6 +238,7 @@ def main() -> None:
                 "metric": "northstar_mesh_threeway_join",
                 "rows": n_orders,
                 "n_shards": N_SHARDS,
+                "ingest_workers": _ingest_workers(),
                 "backend": jax.default_backend(),
                 "ingest_rows_per_sec": round(n_orders / t_ingest, 1),
                 "join_rows_per_sec": round(n_orders / t_join, 1),

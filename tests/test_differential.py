@@ -503,3 +503,39 @@ def test_random_csv_sink_byte_parity(rows):
     assert (host_err is None) == (dev_err is None)
     if host_err is None:
         assert b.getvalue() == a.getvalue()
+
+
+def test_sharded_ingest_worker_count_unobservable(tmp_path, monkeypatch):
+    """Parallel-ingest determinism on the MESH path: the staged
+    multi-worker pipeline (CSVPLUS_INGEST_WORKERS=1/2/8) must land
+    bitwise-identical sharded tables — same chunk boundaries feed the
+    monotone chunk->shard assignment and the per-shard typed seal, so
+    placement, demotion, and full-table checksums cannot depend on K.
+    The file mixes quoted/CRLF carry-over cuts with a typed lane that
+    demotes mid-file."""
+    from csvplus_tpu import from_file
+    from csvplus_tpu.utils.checksum import checksum_device_table
+
+    _needs_mesh()
+    monkeypatch.setenv("CSVPLUS_STREAM_MIN_BYTES", "1")
+    monkeypatch.setenv("CSVPLUS_STREAM_CHUNK_BYTES", "96")
+    rows = []
+    for i in range(160):
+        if i % 5 == 0:
+            rows.append(f'o{i},"q,{i}\r\nx",{i}')
+        else:
+            rows.append(f"o{i},w{i % 3},{i}")
+    rows[120] = "o120,plain,notanint"  # typed lane c demotes mid-file
+    p = tmp_path / "w.csv"
+    p.write_bytes(("a,b,c\r\n" + "\r\n".join(rows) + "\r\n").encode())
+
+    host = run_either(Take(from_file(str(p))), [])
+    sums = {}
+    for k in ("1", "2", "8"):
+        monkeypatch.setenv("CSVPLUS_INGEST_WORKERS", k)
+        src = from_file(str(p)).on_device("cpu", shards=8)
+        table = src.plan.table
+        cols = sorted(table.columns)
+        sums[k] = checksum_device_table(table, cols, positional=True)
+        assert run_either(src, []) == host, f"workers={k}"
+    assert sums["2"] == sums["1"] and sums["8"] == sums["1"], sums

@@ -532,3 +532,107 @@ def test_wide_field_two_lane_encode_differential():
         want_d, want_c = np.unique(vals, return_inverse=True)
         assert (d.astype(want_d.dtype) == want_d).all(), trial
         assert (codes == want_c).all(), trial
+
+
+def _stream_outcome(path, workers, chunk_bytes, **kw):
+    """One staged-pipeline run folded to a comparable value: the full
+    per-chunk yield sequence, or the exception class + message."""
+    import numpy as np
+
+    reader = from_file(path)
+    if kw.get("delimiter"):
+        reader = reader.delimiter(kw["delimiter"])
+    if kw.get("comment"):
+        reader = reader.comment_char(kw["comment"])
+    if kw.get("lazy_quotes"):
+        reader = reader.lazy_quotes()
+    out = []
+    try:
+        for names, encoded, n in native.stream_encoded_chunks(
+            reader, path, chunk_bytes=chunk_bytes, workers=workers
+        ):
+            chunk = {}
+            for c, enc in encoded.items():
+                if len(enc) == 3 and enc[0] == "int":
+                    chunk[c] = ("typed", enc[1], enc[2].tolist())
+                else:
+                    chunk[c] = (
+                        "dict",
+                        [bytes(x) for x in enc[0].tolist()],
+                        np.asarray(enc[1]).tolist(),
+                    )
+            out.append((tuple(names), sorted(chunk.items()), n))
+    except (DataSourceError, native.StreamFallback) as e:
+        return ("exc", type(e).__name__, str(e), len(out))
+    return ("ok", out)
+
+
+def test_stream_pipeline_workers_fuzz(tmp_path):
+    """The staged multi-worker ingest pipeline vs the serial stream on
+    fuzzed bytes: random worker counts and chunk sizes over the same
+    token space that caught the CRLF-at-EOF divergence in PR 2.  The
+    ordered reassembler must make K unobservable — identical per-chunk
+    yields, identical exception (type, message, and how many chunks
+    were emitted before it) for every worker count."""
+    import random
+
+    for seed in range(120):
+        rng = random.Random(7000 + seed)
+        text = "".join(
+            rng.choice(_FUZZ_TOKENS) for _ in range(rng.randrange(1, 60))
+        )
+        kw = rng.choice(_FUZZ_DIALECTS)
+        p = tmp_path / f"f{seed}.csv"
+        p.write_bytes(text.encode("utf-8"))
+        path = str(p)
+        chunk_bytes = rng.randrange(4, 96)
+        want = _stream_outcome(path, 1, chunk_bytes, **kw)
+        for workers in (2, rng.randrange(3, 9)):
+            got = _stream_outcome(path, workers, chunk_bytes, **kw)
+            assert got == want, (seed, workers, chunk_bytes, kw, text)
+
+
+def test_stream_pipeline_workers_typed_fuzz(tmp_path):
+    """Typed-lane chunks under the staged pipeline: random integer
+    columns with affix prefixes, random demotion points, random worker
+    counts — the K=1 stream is the oracle."""
+    import random
+
+    for seed in range(40):
+        rng = random.Random(8100 + seed)
+        n = rng.randrange(5, 120)
+        demote_at = rng.randrange(0, n) if rng.random() < 0.7 else -1
+        rows = []
+        for i in range(n):
+            a = f"o{i * rng.randrange(1, 5)}"
+            b = str(rng.randrange(-500, 500))
+            if i == demote_at:
+                b = rng.choice(["x", "1.5", "o7", ""])
+            rows.append(f"{a},{b}")
+        p = tmp_path / f"t{seed}.csv"
+        p.write_bytes(("id,val\n" + "\n".join(rows) + "\n").encode())
+        path = str(p)
+        chunk_bytes = rng.randrange(8, 200)
+        want = _stream_outcome(path, 1, chunk_bytes)
+        for workers in (2, rng.randrange(3, 9)):
+            assert _stream_outcome(path, workers, chunk_bytes) == want, (
+                seed, workers, chunk_bytes,
+            )
+
+
+def test_scan_threads_env_cap(monkeypatch):
+    """CSVPLUS_SCAN_THREADS caps the intra-chunk scan fan-out; a cap of
+    1 forces the single-pass scan and the output is identical."""
+    import numpy as np
+
+    import csvplus_tpu.native.scanner as sc
+
+    monkeypatch.setattr(sc, "_PARALLEL_MIN_BYTES", 4)
+    data = ("a,b\n" + "".join(f"{i},{i % 9}\n" for i in range(500))).encode()
+    want = sc.scan_bytes(data)
+    for cap in ("1", "2", "16", "junk"):
+        monkeypatch.setenv("CSVPLUS_SCAN_THREADS", cap)
+        got = sc.scan_bytes_parallel(data, n_threads=8)
+        for a, b in zip(want[:3], got[:3]):
+            assert np.array_equal(a, b)
+        assert want[3] == got[3]

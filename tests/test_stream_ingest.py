@@ -22,13 +22,13 @@ def _write(tmp_path, text, name="s.csv"):
     return str(p)
 
 
-def _collect(reader, path, chunk_bytes):
+def _collect(reader, path, chunk_bytes, workers=None):
     """Run the streaming generator and decode back to column strings."""
     names = None
     cols = {}
     total = 0
     for cnames, encoded, n in native.stream_encoded_chunks(
-        reader, path, chunk_bytes=chunk_bytes
+        reader, path, chunk_bytes=chunk_bytes, workers=workers
     ):
         if names is None:
             names = cnames
@@ -392,3 +392,144 @@ def test_stream_quoted_midscale_realistic_chunks(tmp_path, monkeypatch):
         checksum_device_table(t_whole, cols, positional=True)
     )
     assert t_stream.nrows == n
+
+
+# ---------------------------------------------------------------------------
+# Staged multi-worker pipeline (CSVPLUS_INGEST_WORKERS): the ordered
+# reassembler must make worker count UNOBSERVABLE — same per-chunk
+# yields, same demotion chunk, same absolute error numbers for every K.
+# ---------------------------------------------------------------------------
+
+
+def _chunk_stream(reader, path, chunk_bytes, workers):
+    """Per-chunk decoded snapshot (not just the concatenation): chunk
+    boundaries and per-chunk encodings must themselves be identical
+    across worker counts, or the consumer's shard assignment and typed
+    seal points would drift."""
+    out = []
+    for cnames, encoded, n in native.stream_encoded_chunks(
+        reader, path, chunk_bytes=chunk_bytes, workers=workers
+    ):
+        chunk = {}
+        for c in cnames:
+            enc = encoded[c]
+            if len(enc) == 3 and enc[0] == "int":
+                from csvplus_tpu.columnar.typed import format_affix
+
+                chunk[c] = ("typed", enc[1], enc[2].tolist())
+            else:
+                d, codes = enc
+                chunk[c] = (
+                    "dict",
+                    [bytes(x) for x in d.tolist()],
+                    np.asarray(codes).tolist(),
+                )
+        out.append((tuple(cnames), chunk, n))
+    return out
+
+
+def _quoted_crlf_text():
+    rows = []
+    for i in range(180):
+        if i % 4 == 0:
+            rows.append(f'r{i},"v,{i}\r\nnl{i}",{i}')  # CRLF inside quotes
+        elif i % 4 == 1:
+            rows.append(f'r{i},"say ""hi"" {i}",{i}')
+        else:
+            rows.append(f"r{i},plain{i},{i}")
+    return "id,txt,qty\r\n" + "\r\n".join(rows) + "\r\n"
+
+
+@pytest.mark.parametrize("chunk", [24, 96, 1 << 20])
+def test_stream_workers_deterministic_quoted_crlf(tmp_path, chunk):
+    """Quoted/CRLF carry-over cuts: chunk-level output is bitwise-equal
+    for CSVPLUS_INGEST_WORKERS = 1 / 2 / 8."""
+    path = _write(tmp_path, _quoted_crlf_text())
+    base = _chunk_stream(from_file(path), path, chunk, workers=1)
+    for k in (2, 8):
+        assert _chunk_stream(from_file(path), path, chunk, workers=k) == base
+    # and the serial stream still matches the whole-file reader
+    names, cols, _ = _collect(from_file(path), path, chunk, workers=8)
+    want_names, want = from_file(path).read_columns()
+    assert names == want_names and cols == want
+
+
+@pytest.mark.parametrize("workers", [2, 8])
+def test_stream_workers_demotion_midfile(tmp_path, workers):
+    """A typed column that stops conforming mid-file must demote at the
+    SAME chunk index regardless of worker count: later speculative typed
+    results are normalized to the identical dictionary encoding."""
+    rows = [f"o{i},{i}" for i in range(400)]
+    rows[250] = "o250,notanint"  # first non-conforming record
+    text = "id,qty\n" + "\n".join(rows) + "\n"
+    path = _write(tmp_path, text)
+    base = _chunk_stream(from_file(path), path, 64, workers=1)
+    got = _chunk_stream(from_file(path), path, 64, workers=workers)
+    assert got == base
+    # the demotion is visible: qty is typed early, dictionary later
+    kinds = [chunk["qty"][0] for _, chunk, _ in base]
+    assert "typed" in kinds and "dict" in kinds
+
+
+@pytest.mark.parametrize("workers", [1, 2, 8])
+def test_stream_workers_error_absolute_rows(tmp_path, workers):
+    """Field-count errors carry the same absolute record ordinal for
+    every worker count (the reassembler renumbers chunk-relative
+    errors in file order)."""
+    good = "".join(f"{i},x\n" for i in range(100))
+    path = _write(tmp_path, "a,b\n" + good + "oops\n" + "1,2\n" * 50)
+    with pytest.raises(DataSourceError) as ei:
+        _collect(from_file(path), path, 64, workers=workers)
+    assert ei.value.line == 102
+
+
+@pytest.mark.parametrize("workers", [1, 2, 8])
+def test_stream_workers_first_error_wins(tmp_path, workers):
+    """Two bad records in different chunks: the FIRST in file order is
+    reported even when a later chunk finishes scanning earlier."""
+    rows = [f"{i},x" for i in range(200)]
+    rows[60] = "bad60"
+    rows[190] = "bad190"
+    path = _write(tmp_path, "a,b\n" + "\n".join(rows) + "\n")
+    with pytest.raises(DataSourceError) as ei:
+        _collect(from_file(path), path, 32, workers=workers)
+    assert ei.value.line == 62  # header=1, rows[60] is record 62
+
+
+@pytest.mark.parametrize("workers", [1, 2, 8])
+def test_stream_workers_header_only(tmp_path, workers):
+    path = _write(tmp_path, "a,b,c\n")
+    got = _chunk_stream(from_file(path), path, 8, workers=workers)
+    assert got == _chunk_stream(from_file(path), path, 8, workers=1)
+    names, cols, total = _collect(from_file(path), path, 8, workers=workers)
+    assert names == ["a", "b", "c"] and total == 0
+    assert cols == {"a": [], "b": [], "c": []}
+
+
+def test_stream_workers_env_knob(tmp_path, monkeypatch):
+    """CSVPLUS_INGEST_WORKERS drives the consumer path end-to-end and
+    the staged pipeline reports per-worker telemetry."""
+    from csvplus_tpu import Take
+    from csvplus_tpu.utils.observe import telemetry
+
+    monkeypatch.setenv("CSVPLUS_STREAM_MIN_BYTES", "1")
+    monkeypatch.setenv("CSVPLUS_STREAM_CHUNK_BYTES", "64")
+    monkeypatch.setenv("CSVPLUS_INGEST_WORKERS", "3")
+    text = "id,grp,qty\n" + "".join(f"r{i},g{i % 5},{i % 9}\n" for i in range(300))
+    path = _write(tmp_path, text)
+    with telemetry.collect() as records:
+        rows = from_file(path).on_device().to_rows()
+    assert rows == Take(from_file(path)).to_rows()
+    by_stage = {r.stage: r for r in records}
+    assert by_stage["ingest:encode"].extra["workers"] == 3
+    assert by_stage["ingest:scan"].extra["workers"] == 3
+    assert "ingest:cut" in by_stage and "ingest:reorder-stall" in by_stage
+    assert by_stage["ingest:encode"].extra["per_worker_busy_s"]
+
+
+def test_stream_workers_bad_env_degrades(tmp_path, monkeypatch):
+    """A typo'd worker knob degrades to auto instead of aborting."""
+    monkeypatch.setenv("CSVPLUS_INGEST_WORKERS", "lots")
+    path = _write(tmp_path, "a,b\n1,2\n3,4\n")
+    names, cols, total = _collect(from_file(path), path, 8)
+    assert total == 2 and cols["a"] == ["1", "3"]
