@@ -1,7 +1,7 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro bench-mesh bench-ingest dryrun example coldcheck lint
+.PHONY: test soak bench bench-micro bench-mesh bench-ingest dryrun example coldcheck lint analyze asan
 
 test:
 	python -m pytest tests/ -x -q
@@ -12,13 +12,41 @@ test:
 # configs live in pyproject.toml.  A tool that RUNS and finds issues
 # fails the target; a tool that is absent is reported and skipped.
 lint:
-	python -m csvplus_tpu.analysis csvplus_tpu
+	python -m csvplus_tpu.analysis
 	@if python -c "import ruff" >/dev/null 2>&1; then \
 		python -m ruff check csvplus_tpu tests; \
 	else echo "ruff not installed -- skipped"; fi
 	@if python -c "import mypy" >/dev/null 2>&1; then \
 		python -m mypy csvplus_tpu; \
 	else echo "mypy not installed -- skipped"; fi
+
+# Lint + the --json analysis payload (plan-IR verifier reports over the
+# example chains on the hermetic 8-device CPU mesh), snapshot-compared
+# against tests/data/analyze_snapshot.json.  Diagnostic drift exits 3;
+# regenerate deliberately with:
+#   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+#     python -m csvplus_tpu.analysis --write-snapshot tests/data/analyze_snapshot.json
+analyze: lint
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m csvplus_tpu.analysis --json --snapshot tests/data/analyze_snapshot.json >/dev/null
+
+# Native scanner under AddressSanitizer + UBSan: rebuilds scanner.cpp
+# with -fsanitize into a separate artifact (CSVPLUS_NATIVE_SO, so the
+# -O3 cache is untouched) and runs the byte-fuzzer subset of
+# tests/test_native.py under it.  LD_PRELOAD is required because the
+# host interpreter (python) is not asan-linked; leak checking is off
+# for the same reason (the interpreter itself "leaks" at exit).  Skips
+# cleanly when g++ lacks sanitizer runtimes.
+asan:
+	@if g++ -fsanitize=address,undefined -shared -fPIC -x c++ /dev/null -o /tmp/_csvplus_asan_probe.so >/dev/null 2>&1; then \
+		rm -f /tmp/_csvplus_asan_probe.so csvplus_tpu/native/_scanner_asan.so; \
+		CSVPLUS_NATIVE_CFLAGS="-fsanitize=address,undefined -fno-sanitize-recover=all" \
+		CSVPLUS_NATIVE_SO=_scanner_asan.so \
+		LD_PRELOAD="$$(g++ -print-file-name=libasan.so) $$(g++ -print-file-name=libubsan.so)" \
+		ASAN_OPTIONS=detect_leaks=0 \
+		JAX_PLATFORMS=cpu python -m pytest tests/test_native.py -q -k fuzz; \
+		rm -f csvplus_tpu/native/_scanner_asan.so; \
+	else echo "g++ lacks asan/ubsan support -- skipped"; fi
 
 soak:
 	CSVPLUS_HYPOTHESIS_EXAMPLES=1000 python -m pytest tests/ -q

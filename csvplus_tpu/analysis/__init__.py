@@ -1,18 +1,34 @@
 """Static analysis for the device pipeline.
 
-Two layers:
+Three layers:
 
-* :mod:`.verify` + :mod:`.schema` — the plan-IR static verifier, run by
-  the executor before every lowering (``CSVPLUS_VERIFY=0`` disables);
+* :mod:`.verify` + :mod:`.schema` — the plan-IR static verifier
+  (presence/cardinality/lane/PLACEMENT domains), run by the executor
+  before every lowering (``CSVPLUS_VERIFY=0`` disables);
 * :mod:`.astlint` — repo-specific AST lint (ctypes boundary, jit
-  retrace smells), run by ``make lint`` via ``python -m
-  csvplus_tpu.analysis``.
+  retrace/trace-churn, eager hot loops, worker purity), run by
+  ``make lint`` via ``python -m csvplus_tpu.analysis``;
+* :mod:`.report` — the ``--json`` CI payload (lint + example-chain
+  verifier reports) snapshot-compared by ``make analyze``.
 
 See docs/ANALYSIS.md for the rule catalogue.
 """
 
 from .astlint import LintFinding, lint_file, lint_paths, lint_source
-from .schema import Card, ColInfo, NodeState, Presence
+from .report import json_payload
+from .schema import (
+    PLACE_DEVICE,
+    PLACE_HOST,
+    PLACE_UNKNOWN,
+    Card,
+    ColInfo,
+    NodeState,
+    Placement,
+    Presence,
+    placement_of_array,
+    placement_of_column,
+    sharded_placement,
+)
 from .verify import (
     EXECUTOR_MODEL,
     Diagnostic,
@@ -30,11 +46,19 @@ __all__ = [
     "ExecutorModel",
     "LintFinding",
     "NodeState",
+    "PLACE_DEVICE",
+    "PLACE_HOST",
+    "PLACE_UNKNOWN",
+    "Placement",
     "PlanReport",
     "Presence",
+    "json_payload",
     "lint_file",
     "lint_paths",
     "lint_source",
+    "placement_of_array",
+    "placement_of_column",
+    "sharded_placement",
     "verify_before_lower",
     "verify_plan",
 ]

@@ -1,25 +1,77 @@
-"""CLI for the repo AST lint: ``python -m csvplus_tpu.analysis <paths>``.
+"""CLI for the static analysis suite.
 
-Prints one ``path:line: CODE message`` per finding and exits nonzero
-when any finding survives suppression — the ``make lint`` contract.
+``python -m csvplus_tpu.analysis [paths...]``
+    AST lint; with no paths it walks the INSTALLED PACKAGE TREE (resolved
+    from the package itself, not the cwd), so a newly added module can
+    never silently bypass the gate.  Prints ``path:line: CODE message``
+    per finding; exit 1 when any finding survives suppression — the
+    ``make lint`` contract.
+
+``python -m csvplus_tpu.analysis --json [--snapshot FILE]``
+    Machine-readable payload (lint findings + plan-IR verifier reports
+    over the example chains; schema in docs/ANALYSIS.md).  ``--snapshot``
+    compares the payload against a committed expected-diagnostics file
+    and exits 3 on drift; ``--write-snapshot`` regenerates it.  The
+    ``make analyze`` contract.
 """
 
 from __future__ import annotations
 
+import json
 import sys
-
-from .astlint import lint_paths
 
 
 def main(argv=None) -> int:
-    paths = (sys.argv[1:] if argv is None else argv) or ["csvplus_tpu"]
-    findings = lint_paths(paths)
-    for f in findings:
-        print(f)
-    if findings:
-        print(f"{len(findings)} finding(s)", file=sys.stderr)
-        return 1
-    return 0
+    args = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in args
+    if as_json:
+        args.remove("--json")
+    snapshot = write_snapshot = None
+    if "--snapshot" in args:
+        i = args.index("--snapshot")
+        snapshot = args[i + 1]
+        del args[i : i + 2]
+    if "--write-snapshot" in args:
+        i = args.index("--write-snapshot")
+        write_snapshot = args[i + 1]
+        del args[i : i + 2]
+    paths = args or None
+
+    if not (as_json or snapshot or write_snapshot):
+        from .astlint import lint_paths
+        from .report import default_lint_paths
+
+        findings = lint_paths(paths if paths is not None else default_lint_paths())
+        for f in findings:
+            print(f)
+        if findings:
+            print(f"{len(findings)} finding(s)", file=sys.stderr)
+            return 1
+        return 0
+
+    from .report import json_payload
+
+    payload = json_payload(paths)
+    text = json.dumps(payload, indent=2, sort_keys=True)
+    if write_snapshot:
+        with open(write_snapshot, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+        print(f"wrote {write_snapshot}", file=sys.stderr)
+    if as_json:
+        print(text)
+    rc = 1 if payload["lint"] else 0
+    if snapshot:
+        with open(snapshot, "r", encoding="utf-8") as fh:
+            expected = json.load(fh)
+        if expected != payload:
+            print(
+                f"analysis payload drifted from {snapshot} — review and "
+                "regenerate with --write-snapshot",
+                file=sys.stderr,
+            )
+            return 3
+        print(f"payload matches {snapshot}", file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,7 +1,7 @@
 """Repo-specific AST lint: rules generic linters cannot know.
 
-Two boundary classes have bitten this codebase and are mechanically
-checkable from the AST:
+Five rule classes have bitten this codebase (or its measured history)
+and are mechanically checkable from the AST:
 
 * **CTYPES001** — the native scanner boundary.  The C ABI's ``c_char``
   takes EXACTLY one byte; ctypes raises a cryptic ``TypeError`` (or
@@ -20,12 +20,39 @@ checkable from the AST:
   trace + compile (one per chunk-count in the ingest profile).  Such
   kernels should be eager, take a fixed arity, or carry an explicit
   suppression acknowledging the retrace cost.
+* **TRACE001** — the trace-churn boundary (the ``_values_concat``
+  regression class).  A jit-wrapped callable CONSTRUCTED inside a
+  function body is rebuilt — and retraced — on every call; jit
+  construction with a non-hashable ``static_argnums``/``static_argnames``
+  literal fails at first call.  Sanctioned shapes: module-level jitted
+  kernels (``_translate_dense_kernel``), and construction memoized into
+  module-owned state (a ``global``-declared name, or a module-level
+  cache like ``_JIT_KERNELS.update(...)``) so it happens once.
+* **EAGER001** — the unfused-hot-loop boundary (the r06 regression:
+  eager per-column translate/pack loops cost 3x the warm sharded join).
+  A plain Python ``for`` loop in a HOT module (``ops/``,
+  ``columnar/typed.py``, ``columnar/table.py``) issuing two or more
+  unfused jnp element-wise transforms per iteration, outside any jit
+  context (neither jit-decorated nor called from a same-module jitted
+  kernel), dispatches each op eagerly per column per execution.
+* **THREAD001** — the worker-purity boundary (the r07 invariant: "all
+  cross-chunk state lives in the reassembler").  In a module defining a
+  stream worker entry (``_scan_encode_chunk``), no function reachable
+  from the worker may mutate module-global state (or the shared context
+  argument) — except under a module-level ``threading.Lock``/``RLock``
+  ``with`` block (double-checked pool/library init) or into
+  ``threading.local()`` storage.
+
+Each of TRACE001/EAGER001/THREAD001 carries an explicit allowance list
+below (``*_ALLOWED``) that STARTS EMPTY and must stay empty for the
+current tree; additions need review.
 
 Suppression: a ``# analysis: allow[CODE]`` comment on the flagged line
 or on the enclosing ``def`` line.
 
-Run over the tree with ``python -m csvplus_tpu.analysis <paths...>``
-(wired into ``make lint``).
+Run over the tree with ``python -m csvplus_tpu.analysis`` (no
+arguments = the whole installed package tree, so a new module can never
+bypass the gate; wired into ``make lint``).
 """
 
 from __future__ import annotations
@@ -40,7 +67,7 @@ __all__ = ["LintFinding", "lint_source", "lint_file", "lint_paths"]
 
 @dataclass(frozen=True)
 class LintFinding:
-    code: str  # "CTYPES001" | "JIT001"
+    code: str  # "CTYPES001" | "JIT001" | "TRACE001" | "EAGER001" | "THREAD001"
     path: str
     line: int
     message: str
@@ -272,6 +299,535 @@ class _JitVisitor(ast.NodeVisitor):
                         return
 
 
+# ---------------------------------------------------------------------------
+# TRACE001 / EAGER001 / THREAD001 — regression-derived rules (ISSUE 5).
+# Allowance lists start EMPTY and must stay empty on the current tree:
+# entries are "<file basename>:<enclosing function>" and need review.
+# ---------------------------------------------------------------------------
+
+TRACE001_ALLOWED: frozenset = frozenset()
+EAGER001_ALLOWED: frozenset = frozenset()
+THREAD001_ALLOWED: frozenset = frozenset()
+
+# modules whose per-row loops sit on the measured hot path (r06)
+_EAGER_HOT_DIRS = ("ops",)
+_EAGER_HOT_FILES = ("typed.py", "table.py")
+
+# worker entry points whose reachable call graph must stay pure (r07)
+_WORKER_ENTRY_NAMES = ("_scan_encode_chunk",)
+
+_EAGER_TRANSFORM_OPS = frozenset(
+    {
+        "where",
+        "take",
+        "take_along_axis",
+        "clip",
+        "searchsorted",
+        "minimum",
+        "maximum",
+        "equal",
+        "not_equal",
+        "greater",
+        "greater_equal",
+        "less",
+        "less_equal",
+        "left_shift",
+        "right_shift",
+        "bitwise_or",
+        "bitwise_and",
+        "bitwise_xor",
+        "add",
+        "subtract",
+        "multiply",
+        "sum",
+        "cumsum",
+        "select",
+    }
+)
+
+_MUTATING_METHODS = frozenset(
+    {
+        "append",
+        "add",
+        "update",
+        "extend",
+        "insert",
+        "pop",
+        "popitem",
+        "remove",
+        "discard",
+        "clear",
+        "setdefault",
+        "sort",
+        "reverse",
+    }
+)
+
+
+def _allow_key(path: str, func: Optional[ast.AST]) -> str:
+    name = getattr(func, "name", "<module>") if func is not None else "<module>"
+    return f"{Path(path).name}:{name}"
+
+
+def _module_level_names(tree: ast.Module) -> Set[str]:
+    """Names bound at module scope (assignments, defs, imports)."""
+    out: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(stmt.target, ast.Name):
+                out.add(stmt.target.id)
+        elif isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            out.add(stmt.name)
+        elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            for a in stmt.names:
+                out.add((a.asname or a.name).split(".")[0])
+    return out
+
+
+def _jit_construction(call: ast.Call) -> bool:
+    """A call whose RESULT is a jit-wrapped callable: ``jax.jit(...)``,
+    ``jit(...)``, or ``functools.partial(jax.jit, ...)``."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "jit":
+        return True
+    if isinstance(f, ast.Name) and f.id == "jit":
+        return True
+    if (isinstance(f, ast.Attribute) and f.attr == "partial") or (
+        isinstance(f, ast.Name) and f.id == "partial"
+    ):
+        return bool(call.args) and _is_jit_decorator(call.args[0])
+    return False
+
+
+def _declared_globals(func: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(func):
+        if isinstance(n, ast.Global):
+            out.update(n.names)
+    return out
+
+
+def _root_name(node: ast.expr) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _TraceVisitor(_FunctionStack):
+    """TRACE001: jit construction inside a function body (unless stored
+    into module-owned state) and non-hashable static-arg literals."""
+
+    def __init__(self, path: str, tree: ast.Module):
+        super().__init__()
+        self.path = path
+        self.module_names = _module_level_names(tree)
+        self.findings: List[LintFinding] = []
+        # decorator expressions are governed by the FunctionDef branch,
+        # not the Call branch (a nested `@partial(jax.jit, ...)` def is
+        # one construction, not two)
+        self._decorator_nodes = {
+            id(sub)
+            for f in ast.walk(tree)
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+            for d in f.decorator_list
+            for sub in ast.walk(d)
+        }
+
+    def _flag(self, line: int, func: Optional[ast.AST], message: str) -> None:
+        if _allow_key(self.path, func) in TRACE001_ALLOWED:
+            return
+        self.findings.append(LintFinding("TRACE001", self.path, line, message))
+
+    def _stores_to_module_state(self, outer: ast.AST, match) -> bool:
+        """True when an assignment in *outer* whose value satisfies
+        *match* targets a ``global``-declared name, a module-level name,
+        or a subscript/attribute of one — the sanctioned memoization."""
+        owned = _declared_globals(outer) | self.module_names
+        for n in ast.walk(outer):
+            if isinstance(n, ast.Assign) and match(n.value):
+                for t in n.targets:
+                    if isinstance(t, ast.Name) and t.id in owned:
+                        return True
+                    if isinstance(t, (ast.Subscript, ast.Attribute)):
+                        root = _root_name(t)
+                        if root is not None and root in owned:
+                            return True
+        return False
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        outer = self.current
+        if outer is not None and any(
+            _is_jit_decorator(d) for d in node.decorator_list
+        ):
+            escapes = self._stores_to_module_state(
+                outer,
+                lambda v: any(
+                    isinstance(s, ast.Name) and s.id == node.name
+                    for s in ast.walk(v)
+                ),
+            )
+            if not escapes:
+                self._flag(
+                    node.lineno,
+                    outer,
+                    f"jit-wrapped `{node.name}` is constructed inside "
+                    f"`{outer.name}`: retraced on every call — hoist to a "
+                    "module-level kernel or memoize into module state",
+                )
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        if not _jit_construction(node):
+            return
+        func = self.current
+        for kw in node.keywords:
+            if kw.arg in ("static_argnums", "static_argnames") and isinstance(
+                kw.value, (ast.Dict, ast.Set, ast.DictComp, ast.SetComp)
+            ):
+                self._flag(
+                    node.lineno,
+                    func,
+                    f"jit construction passes a non-hashable {kw.arg} "
+                    "literal — fails (or cache-misses) at first call",
+                )
+        if id(node) in self._decorator_nodes:
+            return
+        if func is None:
+            return  # module-level jitted kernels are THE sanctioned shape
+        if self._stores_to_module_state(
+            func, lambda v: any(s is node for s in ast.walk(v))
+        ):
+            return
+        # a module-cache method call, e.g. _JIT_KERNELS.update(k=jax.jit(f))
+        for n in ast.walk(func):
+            if (
+                isinstance(n, ast.Call)
+                and n is not node
+                and isinstance(n.func, ast.Attribute)
+                and _root_name(n.func) in self.module_names
+                and any(s is node for s in ast.walk(n))
+            ):
+                return
+        self._flag(
+            node.lineno,
+            func,
+            f"jit-wrapped callable constructed inside `{func.name}`: "
+            "retraced on every call — hoist to a module-level kernel or "
+            "memoize into module state",
+        )
+
+
+def _is_hot_module(path: str) -> bool:
+    p = Path(path)
+    return p.name in _EAGER_HOT_FILES or any(
+        d in _EAGER_HOT_DIRS for d in p.parts[:-1]
+    )
+
+
+def _jit_context_names(tree: ast.Module) -> Set[str]:
+    """Function names that execute under jit in THIS module: defs with a
+    jit decorator, defs passed to a jit construction, and everything
+    they transitively call by local name."""
+    defs: Dict[str, List[ast.AST]] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(n.name, []).append(n)
+    roots: Set[str] = set()
+    for name, nodes in defs.items():
+        if any(
+            _is_jit_decorator(dec) for d in nodes for dec in d.decorator_list
+        ):
+            roots.add(name)
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) and _jit_construction(n) and n.args:
+            a = n.args[0]
+            if isinstance(a, ast.Name) and a.id in defs:
+                roots.add(a.id)
+    seen: Set[str] = set()
+    work = list(roots)
+    while work:
+        name = work.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for d in defs.get(name, []):
+            for sub in ast.walk(d):
+                if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                    if sub.func.id in defs and sub.func.id not in seen:
+                        work.append(sub.func.id)
+    return seen
+
+
+def _eager_counted_call(sub: ast.AST) -> bool:
+    if not isinstance(sub, ast.Call) or not isinstance(sub.func, ast.Attribute):
+        return False
+    f = sub.func
+    if f.attr == "astype":
+        # only a jnp-dtype astype is a device dispatch; numpy astypes
+        # (host packers) are not the r06 shape
+        return (
+            bool(sub.args)
+            and isinstance(sub.args[0], ast.Attribute)
+            and isinstance(sub.args[0].value, ast.Name)
+            and sub.args[0].value.id == "jnp"
+        )
+    root = f.value
+    while isinstance(root, ast.Attribute):
+        root = root.value
+    if isinstance(root, ast.Name) and root.id in ("jnp", "jax", "lax"):
+        return f.attr in _EAGER_TRANSFORM_OPS
+    return False
+
+
+_EAGER_BINOPS = (
+    ast.BitOr,
+    ast.BitAnd,
+    ast.BitXor,
+    ast.LShift,
+    ast.RShift,
+    ast.Add,
+    ast.Sub,
+    ast.Mult,
+)
+
+
+def _eager_score(loop: ast.For) -> int:
+    """Unfused element-wise device dispatches per loop iteration:
+    jnp/lax transform calls, jnp-dtype ``.astype``, and arithmetic/bit
+    operators whose operands contain one (each eager ``|``/``<<``/``+``
+    over jax arrays is its own dispatch — the r06 pack-loop shape)."""
+    count = 0
+    for sub in ast.walk(loop):
+        if _eager_counted_call(sub):
+            count += 1
+        elif isinstance(sub, ast.BinOp) and isinstance(sub.op, _EAGER_BINOPS):
+            if any(_eager_counted_call(s) for s in ast.walk(sub)):
+                count += 1
+        elif isinstance(sub, ast.AugAssign) and isinstance(
+            sub.op, _EAGER_BINOPS
+        ):
+            if any(_eager_counted_call(s) for s in ast.walk(sub.value)):
+                count += 1
+    return count
+
+
+class _EagerVisitor(_FunctionStack):
+    """EAGER001: eager per-column loops in hot modules (r06 shape)."""
+
+    def __init__(self, path: str, tree: ast.Module):
+        super().__init__()
+        self.path = path
+        self.jit_names = _jit_context_names(tree)
+        self.findings: List[LintFinding] = []
+
+    def _in_jit_context(self) -> bool:
+        for f in self.stack:
+            if f.name in self.jit_names or any(
+                _is_jit_decorator(d) for d in f.decorator_list
+            ):
+                return True
+        return False
+
+    def visit_For(self, node: ast.For) -> None:
+        if not self._in_jit_context():
+            score = _eager_score(node)
+            if score >= 2 and _allow_key(self.path, self.current) not in (
+                EAGER001_ALLOWED
+            ):
+                self.findings.append(
+                    LintFinding(
+                        "EAGER001",
+                        self.path,
+                        node.lineno,
+                        f"eager loop issues {score} unfused jnp element-wise "
+                        "dispatches per iteration in a hot module — fuse "
+                        "into a module-level jitted kernel (r06 regression "
+                        "shape)",
+                    )
+                )
+        self.generic_visit(node)
+
+
+def _lock_names(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for stmt in tree.body:
+        if not (isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call)):
+            continue
+        f = stmt.value.func
+        attr = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if attr in ("Lock", "RLock"):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _thread_local_names(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for stmt in tree.body:
+        if not (isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call)):
+            continue
+        f = stmt.value.func
+        if isinstance(f, ast.Attribute) and f.attr == "local":
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _thread_findings(tree: ast.Module, path: str) -> List[LintFinding]:
+    """THREAD001 over one module, active only when it defines a worker
+    entry (``_scan_encode_chunk``).  Walks the same-module call graph
+    from the entry, propagating which parameters alias the SHARED
+    context (the entry's first argument), and flags any mutation of
+    module-global or shared-context state outside a module-level lock's
+    ``with`` block or ``threading.local()`` storage."""
+    defs: Dict[str, ast.AST] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[stmt.name] = stmt
+    entries = [n for n in _WORKER_ENTRY_NAMES if n in defs]
+    if not entries:
+        return []
+    module_names = _module_level_names(tree)
+    locks = _lock_names(tree)
+    tlocals = _thread_local_names(tree)
+
+    def params_of(func: ast.AST) -> List[str]:
+        a = func.args
+        return [p.arg for p in a.posonlyargs + a.args]
+
+    # reachable functions with the set of parameters aliasing the shared
+    # context, to a fixpoint (conservative union across call sites)
+    tracked: Dict[str, Set[str]] = {}
+    for e in entries:
+        ps = params_of(defs[e])
+        tracked[e] = {ps[0]} if ps else set()
+    work = list(entries)
+    while work:
+        name = work.pop()
+        func = defs[name]
+        t = tracked.get(name, set())
+        for sub in ast.walk(func):
+            if not (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)):
+                continue
+            callee = sub.func.id
+            if callee not in defs:
+                continue
+            callee_params = params_of(defs[callee])
+            passed: Set[str] = set()
+            for i, a in enumerate(sub.args):
+                if isinstance(a, ast.Name) and a.id in t and i < len(callee_params):
+                    passed.add(callee_params[i])
+            for kw in sub.keywords:
+                if (
+                    kw.arg is not None
+                    and isinstance(kw.value, ast.Name)
+                    and kw.value.id in t
+                ):
+                    passed.add(kw.arg)
+            prev = tracked.get(callee)
+            if prev is None or not passed <= prev:
+                tracked[callee] = (prev or set()) | passed
+                work.append(callee)
+
+    findings: List[LintFinding] = []
+    for name, ctx_params in tracked.items():
+        func = defs[name]
+        spans = [
+            (w.lineno, getattr(w, "end_lineno", w.lineno))
+            for w in ast.walk(func)
+            if isinstance(w, ast.With)
+            and any(
+                isinstance(item.context_expr, ast.Name)
+                and item.context_expr.id in locks
+                for item in w.items
+            )
+        ]
+        g = _declared_globals(func)
+
+        def lock_guarded(line: int) -> bool:
+            return any(lo <= line <= hi for lo, hi in spans)
+
+        def flag(line: int, what: str) -> None:
+            if _allow_key(path, func) in THREAD001_ALLOWED:
+                return
+            findings.append(
+                LintFinding(
+                    "THREAD001",
+                    path,
+                    line,
+                    f"`{name}` is reachable from worker "
+                    f"`{_WORKER_ENTRY_NAMES[0]}` and {what} outside a "
+                    "module-level lock — cross-chunk state must live in "
+                    "the reassembler (r07 invariant)",
+                )
+            )
+
+        def check_store_target(t: ast.expr, line: int) -> None:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for el in t.elts:
+                    check_store_target(el, line)
+                return
+            if isinstance(t, ast.Name):
+                if t.id in g and not lock_guarded(line):
+                    flag(line, f"stores module global `{t.id}`")
+                return
+            if isinstance(t, (ast.Attribute, ast.Subscript)):
+                root = _root_name(t)
+                if root is None or root in tlocals or lock_guarded(line):
+                    return
+                if root in ctx_params:
+                    flag(line, f"mutates the shared context `{root}`")
+                elif root in g or (root in module_names and root not in defs):
+                    flag(line, f"mutates module-global `{root}`")
+
+        for sub in ast.walk(func):
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    check_store_target(t, sub.lineno)
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                check_store_target(sub.target, sub.lineno)
+            elif (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _MUTATING_METHODS
+            ):
+                root = _root_name(sub.func)
+                if (
+                    root is not None
+                    and root not in tlocals
+                    and not lock_guarded(sub.lineno)
+                ):
+                    if root in ctx_params:
+                        flag(
+                            sub.lineno,
+                            f"calls `{root}.{sub.func.attr}(...)` on the "
+                            "shared context",
+                        )
+                    elif root in module_names and root not in defs:
+                        flag(
+                            sub.lineno,
+                            f"calls `{root}.{sub.func.attr}(...)` on a "
+                            "module global",
+                        )
+    return findings
+
+
 def _suppressed(finding: LintFinding, lines: List[str], tree: ast.Module) -> bool:
     marker = f"analysis: allow[{finding.code}]"
 
@@ -302,6 +858,14 @@ def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
     j = _JitVisitor(path)
     j.visit(tree)
     findings.extend(j.findings)
+    t = _TraceVisitor(path, tree)
+    t.visit(tree)
+    findings.extend(t.findings)
+    if _is_hot_module(path):
+        e = _EagerVisitor(path, tree)
+        e.visit(tree)
+        findings.extend(e.findings)
+    findings.extend(_thread_findings(tree, path))
     lines = source.splitlines()
     findings = [f for f in findings if not _suppressed(f, lines, tree)]
     findings.sort(key=lambda f: (f.path, f.line, f.code))

@@ -1,0 +1,167 @@
+"""Machine-readable analysis reports for CI: the ``--json`` CLI payload.
+
+``json_payload`` bundles (a) the repo AST lint over the package tree and
+(b) plan-IR verifier reports for a fixed set of example chains mirroring
+``examples/quickstart.py`` and ``examples/sharded_join.py`` — the same
+stage shapes users actually run, built over tiny deterministic corpora
+so the payload is stable and committable.  ``make analyze`` compares the
+payload against ``tests/data/analyze_snapshot.json`` so diagnostic drift
+(a new rule firing, a transfer function changing a verdict) shows up as
+a reviewable diff instead of silently shifting runtime behavior.
+
+The mesh-sharded chain needs 8 visible devices (the hermetic CPU mesh:
+``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``,
+exactly what ``make analyze`` and tests/conftest.py set up); with fewer
+devices it is skipped and ``plans`` notes why.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from .astlint import lint_paths
+from .verify import PlanReport, verify_plan
+
+SCHEMA_VERSION = 1
+
+_PACKAGE_DIR = Path(__file__).resolve().parent.parent
+_REPO_ROOT = _PACKAGE_DIR.parent
+
+
+def default_lint_paths() -> List[Path]:
+    """The package tree itself, resolved from THIS file — not the cwd —
+    so ``make lint`` can never miss a newly added module."""
+    return [_PACKAGE_DIR]
+
+
+def lint_json(paths: Optional[List] = None) -> List[dict]:
+    findings = lint_paths(paths if paths is not None else default_lint_paths())
+    out = []
+    for f in findings:
+        p = Path(f.path)
+        try:
+            rel = p.resolve().relative_to(_REPO_ROOT).as_posix()
+        except ValueError:
+            rel = p.as_posix()
+        out.append(
+            {"code": f.code, "path": rel, "line": f.line, "message": f.message}
+        )
+    return out
+
+
+def report_json(report: PlanReport) -> dict:
+    return {
+        "diagnostics": [
+            {
+                "rule": d.rule,
+                "severity": d.severity,
+                "stage": d.stage,
+                "message": d.message,
+            }
+            for d in report.diagnostics
+        ],
+        "final_card": report.final.card.value,
+        "row_placement": repr(report.final.row_placement()),
+        "predicts_empty": report.predicts_empty,
+        "ok": report.ok,
+    }
+
+
+def _mini_corpus():
+    people = [
+        {"id": str(i), "name": n, "surname": s}
+        for i, (n, s) in enumerate(
+            [("Amelia", "Smith"), ("Amelia", "Jones"), ("Jack", "Taylor")]
+        )
+    ]
+    stock = [
+        {"prod_id": "0", "product": "orange", "price": "0.03"},
+        {"prod_id": "1", "product": "apple", "price": "0.02"},
+    ]
+    orders = [
+        {
+            "order_id": str(i),
+            "cust_id": str(i % 3),
+            "prod_id": str(i % 2),
+            "qty": str(i % 9 + 1),
+        }
+        for i in range(64)
+    ]
+    return people, stock, orders
+
+
+def example_plan_reports() -> Dict[str, object]:
+    """Verifier reports (or a skip-reason string) per example chain."""
+    import jax
+
+    from .. import plan as P
+    from ..columnar.table import DeviceTable
+    from ..exprs import SetValue
+    from ..predicates import Like
+    from ..row import Row
+    from ..source import take_rows
+
+    people, stock, orders = _mini_corpus()
+
+    def index_on(rows, *cols):
+        idx = take_rows([Row(r) for r in rows]).index_on(*cols)
+        idx.on_device("cpu")
+        return idx
+
+    people_t = DeviceTable.from_rows(people, device="cpu")
+    orders_t = DeviceTable.from_rows(orders, device="cpu")
+    cust_idx = index_on(people, "id")
+    prod_idx = index_on(stock, "prod_id")
+
+    out: Dict[str, object] = {}
+    # examples/quickstart.py example 1: filter + map + projection
+    out["quickstart-filter-map"] = verify_plan(
+        P.SelectCols(
+            P.MapExpr(
+                P.Filter(P.Scan(people_t), Like({"name": "Amelia"})),
+                SetValue("name", "Julia"),
+            ),
+            ("name", "surname"),
+        )
+    )
+    # examples/quickstart.py example 2: the 3-table join
+    out["quickstart-join"] = verify_plan(
+        P.Join(P.Join(P.Scan(orders_t), cust_idx, ("cust_id",)), prod_idx, ())
+    )
+    # examples/sharded_join.py: mesh-sharded stream probing a
+    # single-device index (the benign-replication placement shape)
+    if len(jax.devices()) >= 8:
+        from ..parallel.mesh import make_mesh
+
+        sharded_t = orders_t.with_sharding(make_mesh(8))
+        out["sharded-join"] = verify_plan(
+            P.Top(
+                P.Filter(
+                    P.Join(
+                        P.SelectCols(P.Scan(sharded_t), ("cust_id", "qty")),
+                        cust_idx,
+                        ("cust_id",),
+                    ),
+                    Like({"name": "Amelia"}),
+                ),
+                5,
+            )
+        )
+    else:
+        out["sharded-join"] = "skipped: fewer than 8 visible devices"
+    return out
+
+
+def json_payload(paths: Optional[List] = None) -> dict:
+    """The full ``--json`` CLI payload (see docs/ANALYSIS.md schema)."""
+    plans = {}
+    for name, rep in sorted(example_plan_reports().items()):
+        plans[name] = (
+            {"skipped": rep} if isinstance(rep, str) else report_json(rep)
+        )
+    return {
+        "schema": SCHEMA_VERSION,
+        "lint": lint_json(paths),
+        "plans": plans,
+    }

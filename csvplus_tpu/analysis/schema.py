@@ -22,6 +22,13 @@ lattices that analysis runs over:
   ``SelectCols`` of a missing name over an empty selection) are tracked
   explicitly: they are 0-length and must never be gathered with live
   row ids.
+* **Placement** — WHERE the column's backing array lives: ``host``
+  (numpy), ``device`` (one accelerator), or ``sharded(axis)`` (a
+  GSPMD-sharded array over a named mesh).  Seeded from array
+  ``.sharding`` metadata exactly like the lane domain is seeded from
+  column kinds — no device sync, ``.sharding`` is free to read.  The
+  lattice bottom is ``unknown`` (synthetic states, fakes): unknown
+  placements are never diagnosed.
 
 The domain is deliberately cheap: states are built from table/column
 *metadata* only (no device syncs — a column whose ``has_absent`` is not
@@ -33,7 +40,90 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field, replace
-from typing import Dict
+from typing import Dict, Tuple
+
+
+@dataclass(frozen=True)
+class Placement:
+    """Where a column's backing array lives.  ``axes`` names the mesh
+    axes a ``sharded`` array is split over (empty when the sharding
+    carries no named mesh)."""
+
+    kind: str  # "unknown" | "host" | "device" | "sharded"
+    axes: Tuple[str, ...] = ()
+
+    _RANK = {"unknown": 0, "host": 1, "device": 2, "sharded": 3}
+
+    def __repr__(self) -> str:
+        if self.kind == "sharded" and self.axes:
+            return f"sharded({','.join(self.axes)})"
+        return self.kind
+
+    @property
+    def known(self) -> bool:
+        return self.kind != "unknown"
+
+    @property
+    def on_device(self) -> bool:
+        return self.kind in ("device", "sharded")
+
+    @property
+    def is_sharded(self) -> bool:
+        return self.kind == "sharded"
+
+    @property
+    def rank(self) -> int:
+        return self._RANK[self.kind]
+
+
+PLACE_UNKNOWN = Placement("unknown")
+PLACE_HOST = Placement("host")
+PLACE_DEVICE = Placement("device")
+
+
+def sharded_placement(axes: Tuple[str, ...] = ()) -> Placement:
+    return Placement("sharded", tuple(str(a) for a in axes))
+
+
+def placement_of_array(arr) -> Placement:
+    """Placement from one backing array's metadata (never syncs).
+
+    jax arrays expose ``.sharding``; more than one device in its
+    ``device_set`` means GSPMD-sharded, one means single-device.  numpy
+    arrays (no ``.sharding``) are host-resident."""
+    if arr is None:
+        return PLACE_UNKNOWN
+    sh = getattr(arr, "sharding", None)
+    if sh is None:
+        return PLACE_HOST if hasattr(arr, "dtype") else PLACE_UNKNOWN
+    try:
+        n_dev = len(sh.device_set)
+    except Exception:
+        return PLACE_UNKNOWN
+    if n_dev > 1:
+        mesh = getattr(sh, "mesh", None)
+        axes = tuple(getattr(mesh, "axis_names", ())) if mesh is not None else ()
+        return sharded_placement(axes)
+    return PLACE_DEVICE
+
+
+def placement_of_column(column) -> Placement:
+    """Placement from a live column's metadata.  An explicit
+    ``column.placement`` attribute (a :class:`Placement` or kind
+    string) overrides — the hook synthetic states and tests seed
+    through; real columns are read from their backing arrays
+    (``IntColumn.values`` / ``StringColumn`` codes)."""
+    explicit = getattr(column, "placement", None)
+    if isinstance(explicit, Placement):
+        return explicit
+    if isinstance(explicit, str):
+        return Placement(explicit)
+    if getattr(column, "kind", "str") == "int":
+        return placement_of_array(getattr(column, "values", None))
+    state = getattr(column, "_codes_state", None)
+    if state:
+        return placement_of_array(state[0])
+    return PLACE_UNKNOWN
 
 
 class Presence(enum.Enum):
@@ -73,9 +163,12 @@ class ColInfo:
     lane: str  # "str" (dictionary codes) | "int" (typed int32 lanes)
     presence: Presence
     placeholder: bool = False  # 0-length stand-in from select-of-missing
+    placement: Placement = PLACE_UNKNOWN
 
     def __repr__(self) -> str:
         tag = f"{self.lane}/{self.presence.value}"
+        if self.placement.known:
+            tag += f"/{self.placement!r}"
         return f"<{tag}{'/placeholder' if self.placeholder else ''}>"
 
 
@@ -96,6 +189,17 @@ class NodeState:
     def with_card(self, card: Card) -> "NodeState":
         return NodeState(dict(self.schema), card)
 
+    def row_placement(self) -> Placement:
+        """Where the relation's rows predominantly live: the most
+        distributed known column placement (sharded > device > host).
+        This is the layout the executor materializes stage outputs on,
+        so it is what downstream transfer functions compare against."""
+        best = PLACE_UNKNOWN
+        for info in self.schema.values():
+            if info.placement.rank > best.rank:
+                best = info.placement
+        return best
+
 
 def col_info_for(column) -> ColInfo:
     """ColInfo from a live table column, using only cached metadata.
@@ -105,14 +209,15 @@ def col_info_for(column) -> ColInfo:
     presence comes from the ``_has_absent`` cache when already known;
     an uncached value stays MAYBE rather than forcing a device sync.
     """
+    place = placement_of_column(column)
     if getattr(column, "kind", "str") == "int":
-        return ColInfo("int", Presence.PRESENT)
+        return ColInfo("int", Presence.PRESENT, placement=place)
     cached = getattr(column, "_has_absent", None)
     if cached is False:
-        return ColInfo("str", Presence.PRESENT)
+        return ColInfo("str", Presence.PRESENT, placement=place)
     if cached is True:
-        return ColInfo("str", Presence.MAYBE)
-    return ColInfo("str", Presence.MAYBE)
+        return ColInfo("str", Presence.MAYBE, placement=place)
+    return ColInfo("str", Presence.MAYBE, placement=place)
 
 
 def scan_state(table) -> NodeState:

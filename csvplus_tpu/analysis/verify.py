@@ -22,6 +22,16 @@ checks, per node, against the abstract domain in :mod:`.schema`:
   executor's empty-input guarantees.  The round-5 differential crash
   (empty selection + placeholder columns + a predicate gather) is
   exactly a violation of this rule under the pre-fix model.
+* **placement-flow** — per-column placement (host / single-device /
+  sharded, :class:`~.schema.Placement`) is tracked through every
+  operator and cross-placement hazards are predicted BEFORE lowering:
+  a sharded stream probing a single-device packed index (info when the
+  build side merely replicates, warn when the partitioned tier implies
+  a full ``all_to_all`` reshard of the probe keys — threshold shared
+  with the executor via ``parallel.pjoin.partition_tier_selected``), a
+  rename-merge across placements, and a host-placed stage sandwiched
+  between device stages (an implied gather + re-upload).  Unknown
+  placements (synthetic states, fakes) are never diagnosed.
 * **divergence-risk** — plan shapes with no *random* differential
   coverage (stage kinds, chain depth, typed lanes under predicates) are
   flagged as info so the harness's blind spots are visible per plan.
@@ -50,6 +60,7 @@ from .. import plan as P
 from ..exprs import Rename, SetValue, Update
 from ..predicates import All, Any_, Like, Not
 from .schema import (
+    PLACE_UNKNOWN,
     Card,
     ColInfo,
     NodeState,
@@ -106,6 +117,18 @@ class ExecutorModel:
     join_empty_total: bool = True
     # ops/join.py except_mask reached through a 0-row key view is total.
     except_empty_total: bool = True
+    # parallel/pjoin.py partitioned_probe: the all_to_all tier
+    # answers on the mesh (O(1) scalar syncs only).  A stale False pins
+    # the pre-device-orchestration tier that synced answers through
+    # host — the verifier then warns on every partitioned-tier probe.
+    partitioned_probe_device_resident: bool = True
+    # ops/join.py _lanes_for/_aligned_codes: below the partition
+    # threshold the build side replicates onto the
+    # probe mesh with no host hop.  A stale False makes every
+    # sharded-stream broadcast probe a placement-flow warn — which the
+    # differential verdict contract then falsifies (the sharded random
+    # suite executes those plans with no host fallback).
+    broadcast_replication_on_device: bool = True
 
 
 EXECUTOR_MODEL = ExecutorModel()
@@ -113,7 +136,7 @@ EXECUTOR_MODEL = ExecutorModel()
 
 @dataclass(frozen=True)
 class Diagnostic:
-    rule: str  # "resolution" | "lane-flow" | "empty-relation" | "divergence-risk" | "unlowerable"
+    rule: str  # "resolution" | "lane-flow" | "placement-flow" | "empty-relation" | "divergence-risk" | "unlowerable"
     severity: str  # "error" | "warn" | "info"
     stage: str  # e.g. "Filter[2]" — node type + 0-based chain position
     message: str
@@ -417,7 +440,10 @@ class _Verifier:
                     f'SetValue replaces typed int32 lane "{expr.column}" with a '
                     "dictionary constant column",
                 )
-            out[expr.column] = ColInfo("str", Presence.PRESENT)
+            # the constant column materializes on the stream's layout
+            out[expr.column] = ColInfo(
+                "str", Presence.PRESENT, placement=state.row_placement()
+            )
             return NodeState(out, state.card)
         if isinstance(expr, Rename):
             out = dict(state.schema)
@@ -438,6 +464,19 @@ class _Verifier:
                             "dictionary codes at lowering",
                         )
                         moved = demoted(moved)
+                    if (
+                        moved.placement.known
+                        and existing.placement.known
+                        and moved.placement != existing.placement
+                    ):
+                        self.diag(
+                            "placement-flow",
+                            "warn",
+                            f'rename "{old}"->"{new}" merges a '
+                            f"{moved.placement!r}-placed column onto a "
+                            f"{existing.placement!r}-placed column — the "
+                            "fallback merge implies a transfer to one layout",
+                        )
                 out[new] = moved
             return NodeState(out, state.card)
         self.diag(
@@ -445,7 +484,9 @@ class _Verifier:
         )
         return state
 
-    def _index_info(self, node) -> "Optional[Tuple[Dict[str, str], Tuple[str, ...], bool]]":
+    def _index_info(
+        self, node
+    ) -> "Optional[Tuple[Dict[str, str], Tuple[str, ...], bool, Optional[dict]]]":
         from ..ops.join import device_index_static_info
 
         kind = type(node).__name__.lower()
@@ -458,6 +499,82 @@ class _Verifier:
             )
             return None
         return info
+
+    def _check_placement_probe(
+        self, state: NodeState, meta: "Optional[dict]", what: str
+    ) -> None:
+        """placement-flow rule for a probe (Join/Except) stage: compare
+        where the stream rows live against where the build side's packed
+        keys live and predict the executor's tier choice."""
+        if meta is None:
+            return
+        stream = state.row_placement()
+        idx_place = meta.get("placement", PLACE_UNKNOWN)
+        if not stream.known or not idx_place.known:
+            return
+        if stream.is_sharded and not idx_place.is_sharded:
+            from ..parallel.pjoin import partition_tier_selected
+
+            n_keys = meta.get("packed_keys")
+            min_keys = meta.get("partition_min_keys") or 0
+            if n_keys is not None and partition_tier_selected(
+                n_keys, stream_sharded=True, min_keys=min_keys
+            ):
+                if self.model.partitioned_probe_device_resident:
+                    self.diag(
+                        "placement-flow",
+                        "warn",
+                        f"sharded stream probes a {idx_place.kind}-placed "
+                        f"{what} index of {n_keys} keys — the partitioned "
+                        "tier implies a full all_to_all reshard of the "
+                        "probe keys",
+                    )
+                else:
+                    self.diag(
+                        "placement-flow",
+                        "warn",
+                        f"sharded stream probes a {idx_place.kind}-placed "
+                        f"{what} index of {n_keys} keys — modelled "
+                        "partitioned tier syncs answers through host "
+                        "(full gather)",
+                    )
+            elif self.model.broadcast_replication_on_device:
+                self.diag(
+                    "placement-flow",
+                    "info",
+                    f"sharded stream probes a {idx_place.kind}-placed "
+                    f"{what} index — build side replicates onto the probe "
+                    "mesh (benign broadcast, no host hop)",
+                )
+            else:
+                self.diag(
+                    "placement-flow",
+                    "warn",
+                    f"sharded stream probes a {idx_place.kind}-placed "
+                    f"{what} index — modelled broadcast tier gathers the "
+                    "probe keys to one device",
+                )
+        elif stream.kind == "host" and idx_place.on_device:
+            self.diag(
+                "placement-flow",
+                "warn",
+                f"host-placed stream probes a {idx_place!r} {what} index — "
+                "implied full upload of the probe keys at lowering",
+            )
+        elif stream.on_device and idx_place.kind == "host":
+            self.diag(
+                "placement-flow",
+                "warn",
+                f"{stream!r} stream probes a host-placed {what} index — "
+                "implied full gather of the probe keys at lowering",
+            )
+        elif stream.kind == "device" and idx_place.is_sharded:
+            self.diag(
+                "placement-flow",
+                "info",
+                f"single-device stream probes a {idx_place!r} {what} index "
+                "— answers replicate back to the stream device (benign)",
+            )
 
     def _check_keys(self, node, state: NodeState, what: str, index_kinds) -> None:
         for c in node.columns:
@@ -486,6 +603,9 @@ class _Verifier:
         info = self._index_info(node)
         index_kinds = info[0] if info is not None else None
         self._check_keys(node, state, "join", index_kinds)
+        self._check_placement_probe(
+            state, info[3] if info is not None else None, "join"
+        )
         if not self.model.join_empty_total and state.card.may_be_empty:
             self.diag(
                 "empty-relation",
@@ -493,14 +613,18 @@ class _Verifier:
                 "join over a possibly-empty stream requires the executor's "
                 "nrows==0 early-out (join_tables)",
             )
+        # the joined relation materializes on the STREAM's layout (the
+        # build side replicates or answers through the partitioned
+        # shuffle; either way output columns follow the probe rows)
+        stream_place = state.row_placement()
         out: Dict[str, ColInfo] = {}
         if index_kinds is not None:
             for n, kind in index_kinds.items():
-                out[n] = ColInfo(kind, Presence.MAYBE)
+                out[n] = ColInfo(kind, Presence.MAYBE, placement=stream_place)
         for n, i in state.schema.items():
             if n in out and out[n].lane != i.lane:
                 # stream-wins merge across lanes settles on codes
-                out[n] = ColInfo("str", Presence.MAYBE)
+                out[n] = ColInfo("str", Presence.MAYBE, placement=stream_place)
             else:
                 out[n] = replace(i, presence=Presence.MAYBE)
         for c in node.columns:
@@ -513,6 +637,9 @@ class _Verifier:
         info = self._index_info(node)
         index_kinds = info[0] if info is not None else None
         self._check_keys(node, state, "except", index_kinds)
+        self._check_placement_probe(
+            state, info[3] if info is not None else None, "except"
+        )
         if not self.model.except_empty_total and state.card.may_be_empty:
             self.diag(
                 "empty-relation",
@@ -538,12 +665,32 @@ class _Verifier:
         self.report.states.append(state)
         n_stages = len(chain) - 1
         for pos, node in enumerate(chain[1:], start=1):
-            self._stage_label = f"{type(node).__name__}[{pos}]"
+            self._stage_label = P.stage_label(pos, node)
             state = self.transfer(node, state, is_last=pos == n_stages)
             self.report.states.append(state)
+        self._host_sandwich(chain)
         self._divergence_risk(chain)
         self._publish_counters()
         return self.report
+
+    def _host_sandwich(self, chain: List[P.PlanNode]) -> None:
+        """placement-flow rule: a host-placed stage output between two
+        device-placed ones means the lowered pipeline would gather off
+        the device mid-chain and re-upload — the one placement shape
+        that costs TWO transfers instead of zero."""
+        places = [s.row_placement() for s in self.report.states]
+        on_dev = [p.on_device for p in places]
+        for i in range(1, len(places) - 1):
+            if places[i].kind != "host":
+                continue
+            if any(on_dev[:i]) and any(on_dev[i + 1 :]):
+                self._stage_label = P.stage_label(i, chain[i])
+                self.diag(
+                    "placement-flow",
+                    "warn",
+                    "host-placed stage sandwiched between device stages — "
+                    "implied mid-chain gather + re-upload at lowering",
+                )
 
     def _divergence_risk(self, chain: List[P.PlanNode]) -> None:
         self._stage_label = "plan"

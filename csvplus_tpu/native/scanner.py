@@ -28,7 +28,10 @@ from ..utils.env import env_int as _env_int
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "scanner.cpp")
-_SO = os.path.join(_HERE, "_scanner.so")
+# CSVPLUS_NATIVE_SO picks an alternate artifact name so an instrumented
+# build (e.g. `make asan`) neither reuses nor clobbers the -O3 cache;
+# CSVPLUS_NATIVE_CFLAGS appends extra g++ flags (space-split) to it.
+_SO = os.path.join(_HERE, os.environ.get("CSVPLUS_NATIVE_SO", "_scanner.so"))
 _lock = threading.Lock()
 _lib = None
 
@@ -39,9 +42,12 @@ def _build() -> str:
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return _SO
     tmp = f"{_SO}.{os.getpid()}.tmp"  # per-process: no concurrent clobber
+    extra = os.environ.get("CSVPLUS_NATIVE_CFLAGS", "").split()
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+            + extra
+            + ["-o", tmp, _SRC],
             check=True,
             capture_output=True,
         )

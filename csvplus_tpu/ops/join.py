@@ -187,9 +187,17 @@ def _build_direct_cum(keys: jax.Array, total_bits: int) -> jax.Array:
 
 def device_index_static_info(index):
     """Static shape of an index's device copy, for the plan verifier:
-    ``(column -> lane kind, key column tuple, supported)`` — or ``None``
-    when the index carries no device table (the executor then raises
-    ``UnsupportedPlan`` and the chain falls back to the host path).
+    ``(column -> lane kind, key column tuple, supported, meta)`` — or
+    ``None`` when the index carries no device table (the executor then
+    raises ``UnsupportedPlan`` and the chain falls back to the host
+    path).  ``meta`` feeds the verifier's placement domain:
+
+    * ``placement`` — where the packed key array lives (a
+      :class:`~csvplus_tpu.analysis.schema.Placement`; unknown on fakes
+      that carry no packed arrays);
+    * ``packed_keys`` — build-side key count (``None`` when unknown);
+    * ``partition_min_keys`` — the probe tier threshold, read through
+      the live class so test overrides flow into the model.
 
     Reads only metadata the :class:`DeviceIndex` already holds; never
     touches device arrays, so verification stays O(plan), not O(rows).
@@ -200,11 +208,24 @@ def device_index_static_info(index):
     if not getattr(dev, "supported", False):
         # an unsupported device copy may hold no packed table at all —
         # report the flag without assuming any further structure
-        return ({}, (), False)
+        return ({}, (), False, None)
+    from ..analysis.schema import placement_of_array
+
+    packed = getattr(dev, "packed_i32", None)
+    if packed is None:
+        packed = getattr(dev, "packed_hi", None)
+    meta = {
+        "placement": placement_of_array(packed),
+        "packed_keys": int(packed.shape[0]) if packed is not None else None,
+        "partition_min_keys": int(
+            getattr(dev, "PARTITION_MIN_KEYS", DeviceIndex.PARTITION_MIN_KEYS)
+        ),
+    }
     return (
         {n: c.kind for n, c in dev.table.columns.items()},
         tuple(dev.key_columns),
         True,
+        meta,
     )
 
 
@@ -265,9 +286,12 @@ class DeviceIndex:
             acc += b
 
         if total <= 31:
-            key = jnp.zeros(table.nrows, dtype=jnp.int32)
-            for c, s in zip(cols, shifts):
-                key = key | (c.codes.astype(jnp.int32) << s)
+            # one fused pack kernel (shared with the probe side); build
+            # codes are never negative so the kernel's miss-masking is
+            # the identity here
+            key = _pack_qk_kernel(
+                tuple(c.codes for c in cols), tuple(shifts)
+            )
             direct_bits = total if total <= cls.DIRECT_MAX_BITS else None
             return cls(
                 table, key_columns, key, None, shifts, bits, direct_bits=direct_bits
@@ -528,12 +552,15 @@ class DeviceIndex:
             # probes over ICI all_to_all.  Full-width probes only; prefix
             # probes and unsharded streams broadcast.
             qk_sh = getattr(qk, "sharding", None)
-            if (
-                k == len(self.key_columns)
-                and int(self.packed_i32.shape[0]) >= self.PARTITION_MIN_KEYS
-                and qk_sh is not None
+            from ..parallel.pjoin import partition_tier_selected
+
+            if partition_tier_selected(
+                int(self.packed_i32.shape[0]),
+                full_width=k == len(self.key_columns),
+                stream_sharded=qk_sh is not None
                 and len(qk_sh.device_set) > 1
-                and hasattr(qk_sh, "mesh")
+                and hasattr(qk_sh, "mesh"),
+                min_keys=self.PARTITION_MIN_KEYS,
             ):
                 from ..parallel.pjoin import partitioned_probe_device
 
@@ -574,12 +601,15 @@ class DeviceIndex:
         # large build sides probed by a mesh-sharded stream go through
         # the partitioned all_to_all path, same policy as the i32 tier
         qk_sh = getattr(q_hi, "sharding", None)
-        if (
-            k == len(self.key_columns)
-            and int(self.packed_i64.shape[0]) >= self.PARTITION_MIN_KEYS
-            and qk_sh is not None
+        from ..parallel.pjoin import partition_tier_selected
+
+        if partition_tier_selected(
+            int(self.packed_i64.shape[0]),
+            full_width=k == len(self.key_columns),
+            stream_sharded=qk_sh is not None
             and len(qk_sh.device_set) > 1
-            and hasattr(qk_sh, "mesh")
+            and hasattr(qk_sh, "mesh"),
+            min_keys=self.PARTITION_MIN_KEYS,
         ):
             from ..parallel.pjoin import partitioned_probe_device_wide
 

@@ -64,7 +64,7 @@ def _packed_sort_lanes(key_cols) -> "Optional[Tuple[jax.Array, ...]]":
     nonnegative 31-bit (hi, lo) lanes up to 62, None beyond.  Because
     each dictionary is sorted, packed order == the multi-column
     lexicographic code order the replicated sort produces."""
-    from .join import _bits_for, pack_lanes
+    from .join import _bits_for, _pack_qk_kernel, pack_lanes
 
     bits = [_bits_for(c.dict_size) for c in key_cols]
     total = sum(bits)
@@ -76,9 +76,11 @@ def _packed_sort_lanes(key_cols) -> "Optional[Tuple[jax.Array, ...]]":
         shifts.insert(0, acc)
         acc += b
     if total <= 31:
-        lane = jnp.zeros_like(key_cols[0].codes, dtype=jnp.int32)
-        for c, s in zip(key_cols, shifts):
-            lane = lane | (c.codes.astype(jnp.int32) << s)
+        # fused pack (codes are nonnegative, so the kernel's miss
+        # masking is the identity) instead of an eager per-column loop
+        lane = _pack_qk_kernel(
+            tuple(c.codes for c in key_cols), tuple(shifts)
+        )
         return (lane,)
     hi, lo = pack_lanes([c.codes for c in key_cols], shifts, bits)
     return (hi, lo)

@@ -59,6 +59,24 @@ from .mesh import row_spec, shard_rows
 _SENTINEL = np.int32(np.iinfo(np.int32).max)
 
 
+def partition_tier_selected(
+    n_keys: int, *, full_width: bool = True, stream_sharded: bool = True,
+    min_keys: "int | None" = None,
+) -> bool:
+    """The ONE policy predicate for choosing this module's range-
+    partitioned ``all_to_all`` probe tier over broadcast replication:
+    a full-width probe of at least ``min_keys`` build keys by a
+    mesh-sharded stream.  ``DeviceIndex.probe`` (both key-width tiers)
+    and the plan verifier's placement domain both call it, so the
+    executor and the static model can never disagree about the
+    threshold."""
+    if min_keys is None:
+        from ..ops.join import DeviceIndex
+
+        min_keys = DeviceIndex.PARTITION_MIN_KEYS
+    return bool(full_width and stream_sharded and int(n_keys) >= int(min_keys))
+
+
 # 62-bit sentinel for wide (int64) keys: packed keys keep headroom below
 # it (DeviceIndex._bits_for reserves a slot above every code range)
 _SENT62 = np.int64((1 << 62) - 1)

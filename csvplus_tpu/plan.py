@@ -54,6 +54,14 @@ def walk(root: "PlanNode") -> "Iterator[PlanNode]":
     yield from linearize(root)
 
 
+def stage_label(pos: int, node: "PlanNode") -> str:
+    """The canonical ``Type[pos]`` label for chain position *pos* —
+    shared by the static verifier's diagnostics and the analysis CLI's
+    JSON payload so a diagnostic's ``stage`` field always addresses the
+    same :func:`linearize` slot."""
+    return f"{type(node).__name__}[{pos}]"
+
+
 @dataclass(frozen=True)
 class Scan(PlanNode):
     """Origin: a device columnar table (or a future streaming scan)."""

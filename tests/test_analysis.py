@@ -456,3 +456,337 @@ def test_ingest_pure_typed_column_still_finalizes_as_int(monkeypatch):
     table = _stream_table(monkeypatch, chunks)
     assert table.columns["v"].kind == "int"
     assert _cells(table, "v") == ["o1", "o2", "o3"]
+
+
+# ---- placement-flow verifier rule ------------------------------------
+
+
+def placed_col(place, has_absent=False):
+    c = FakeCol("str", has_absent=has_absent)
+    c.placement = place
+    return c
+
+
+def placed_index(packed, keys=("k",), min_keys=None):
+    """Fake index whose device table carries a real packed key array, so
+    device_index_static_info derives placement/packed_keys/threshold."""
+    dev = SimpleNamespace(
+        table=SimpleNamespace(columns={"k": PRESENT(), "v": PRESENT()}),
+        key_columns=tuple(keys),
+        supported=True,
+        packed_i32=packed,
+    )
+    if min_keys is not None:
+        dev.PARTITION_MIN_KEYS = min_keys
+    return SimpleNamespace(device_table=dev)
+
+
+def _jnp_keys(n):
+    import jax.numpy as jnp
+
+    return jnp.arange(n, dtype=jnp.int32)
+
+
+def test_placement_sharded_probe_small_index_is_benign_info():
+    scan = fake_scan(
+        {"k": placed_col("sharded"), "p": placed_col("sharded")}, nrows=8
+    )
+    report = verify_plan(P.Join(scan, placed_index(_jnp_keys(4)), ("k",)))
+    (diag,) = report.by_rule("placement-flow")
+    assert diag.severity == "info" and "benign broadcast" in diag.message
+    # join-contributed columns inherit the stream's sharded placement
+    assert report.final.schema["v"].placement.is_sharded
+
+
+def test_placement_partitioned_tier_warns_all_to_all():
+    """Lowering the live threshold flips the same probe into the
+    partitioned tier — the shared partition_tier_selected predicate."""
+    scan = fake_scan(
+        {"k": placed_col("sharded"), "p": placed_col("sharded")}, nrows=8
+    )
+    report = verify_plan(
+        P.Join(scan, placed_index(_jnp_keys(4), min_keys=1), ("k",))
+    )
+    (diag,) = report.by_rule("placement-flow")
+    assert diag.severity == "warn" and "all_to_all" in diag.message
+
+
+def test_placement_stale_broadcast_model_warns():
+    """Pin the STALE executor model: if broadcast replication were a
+    host-side gather, every sharded broadcast probe would warn — and the
+    differential verdict contract (device executes these plans with no
+    fallback) would falsify the model."""
+    scan = fake_scan(
+        {"k": placed_col("sharded"), "p": placed_col("sharded")}, nrows=8
+    )
+    report = verify_plan(
+        P.Join(scan, placed_index(_jnp_keys(4)), ("k",)),
+        ExecutorModel(broadcast_replication_on_device=False),
+    )
+    (diag,) = report.by_rule("placement-flow")
+    assert diag.severity == "warn" and "gathers the probe keys" in diag.message
+
+
+def test_placement_host_device_probe_crossings_warn():
+    # host stream x device index: full upload of the probe keys
+    scan = fake_scan({"k": placed_col("host")}, nrows=8)
+    report = verify_plan(P.Join(scan, placed_index(_jnp_keys(4)), ("k",)))
+    (diag,) = report.by_rule("placement-flow")
+    assert diag.severity == "warn" and "upload" in diag.message
+    # device stream x host index (numpy packed array): full gather
+    scan2 = fake_scan({"k": placed_col("device")}, nrows=8)
+    report2 = verify_plan(
+        P.Join(scan2, placed_index(np.arange(4, dtype=np.int32)), ("k",))
+    )
+    (diag2,) = report2.by_rule("placement-flow")
+    assert diag2.severity == "warn" and "gather" in diag2.message
+
+
+def test_placement_unknown_is_never_diagnosed():
+    """Synthetic states (fakes without placement metadata) must stay
+    silent — the rule only speaks when both sides are known."""
+    scan = fake_scan({"k": PRESENT()}, nrows=8)
+    report = verify_plan(P.Join(scan, placed_index(_jnp_keys(4)), ("k",)))
+    assert not report.by_rule("placement-flow")
+
+
+def test_placement_rename_merge_across_placements_warns():
+    scan = fake_scan(
+        {"s": placed_col("host", has_absent=True), "i": placed_col("device")},
+        nrows=4,
+    )
+    report = verify_plan(P.MapExpr(scan, Rename({"s": "i"})))
+    (diag,) = report.by_rule("placement-flow")
+    assert diag.severity == "warn" and "transfer to one layout" in diag.message
+
+
+def test_placement_host_sandwich_between_device_stages_warns():
+    """A host-placed stage output between two device-placed ones is the
+    one shape costing two transfers (gather + re-upload)."""
+    from csvplus_tpu.analysis import (
+        PLACE_DEVICE,
+        PLACE_HOST,
+        ColInfo,
+        NodeState,
+    )
+    from csvplus_tpu.analysis.verify import _Verifier
+
+    def state_at(place):
+        return NodeState(
+            {"a": ColInfo("str", Presence.PRESENT, placement=place)},
+            Card.NONEMPTY,
+        )
+
+    scan = fake_scan({"a": PRESENT()}, nrows=3)
+    chain = [scan, P.Top(scan, 1), P.Top(scan, 1)]
+    v = _Verifier(ExecutorModel())
+    v.report.states = [
+        state_at(PLACE_DEVICE),
+        state_at(PLACE_HOST),
+        state_at(PLACE_DEVICE),
+    ]
+    v._host_sandwich(chain)
+    (diag,) = v.report.by_rule("placement-flow")
+    assert diag.severity == "warn" and "sandwiched" in diag.message
+    assert diag.stage == "Top[1]"
+    # no sandwich when the tail never returns to the device
+    v2 = _Verifier(ExecutorModel())
+    v2.report.states = [
+        state_at(PLACE_DEVICE),
+        state_at(PLACE_HOST),
+        state_at(PLACE_HOST),
+    ]
+    v2._host_sandwich(chain)
+    assert not v2.report.by_rule("placement-flow")
+
+
+# ---- TRACE001 / EAGER001 / THREAD001 (regression-derived lints) ------
+
+
+TRACE_NESTED_JIT = """
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _values_concat(chunks, offs):
+    @partial(jax.jit, static_argnames=("offs",))
+    def k(cks, offs):
+        return jnp.concatenate(cks)
+    return k(tuple(chunks), offs)
+"""
+
+TRACE_CALL_IN_BODY = """
+import jax
+
+
+def run(f, x):
+    return jax.jit(f)(x)
+"""
+
+TRACE_NONHASHABLE_STATIC = """
+import jax
+
+
+def make(f):
+    g = jax.jit(f, static_argnames={"n"})
+    globals()["g"] = g
+"""
+
+TRACE_MEMOIZED_OK = """
+import jax
+
+_CACHE = {}
+
+
+def kernel_for(n):
+    if n not in _CACHE:
+        _CACHE[n] = jax.jit(lambda x: x * n)
+    return _CACHE[n]
+"""
+
+EAGER_R06_PACK = """
+import jax.numpy as jnp
+
+
+def build(cols, shifts):
+    key = jnp.zeros(4, dtype=jnp.int32)
+    for c, s in zip(cols, shifts):
+        key = key | (c.codes.astype(jnp.int32) << s)
+    return key
+"""
+
+EAGER_R06_TRANSLATE = """
+import jax.numpy as jnp
+
+
+def _translate_by_values(cols, table):
+    out = []
+    for c in cols:
+        pos = jnp.searchsorted(table, c.codes)
+        hit = jnp.take(table, pos, mode="clip") == c.codes
+        out.append(jnp.where(hit, pos, -1))
+    return out
+"""
+
+THREAD_SHARED_STATE = """
+_seen = {}
+
+
+def _scan_encode_chunk(ctx, data):
+    global _seen
+    _seen[ctx.chunk_id] = len(data)
+    ctx.total = len(data)
+    return data
+"""
+
+THREAD_LOCKED_OK = """
+import threading
+
+_lock = threading.Lock()
+_seen = {}
+
+
+def _scan_encode_chunk(ctx, data):
+    global _seen
+    with _lock:
+        _seen[id(data)] = len(data)
+    return data
+"""
+
+
+def test_trace001_fires_on_nested_jit_def():
+    """The pre-fix `_values_concat` shape: a jit-wrapped kernel built
+    inside the function body, retraced on every call."""
+    (f,) = lint_source(TRACE_NESTED_JIT)
+    assert f.code == "TRACE001" and "_values_concat" in f.message
+    assert "retraced on every call" in f.message
+
+
+def test_trace001_fires_on_jit_call_in_body():
+    (f,) = lint_source(TRACE_CALL_IN_BODY)
+    assert f.code == "TRACE001" and "`run`" in f.message
+
+
+def test_trace001_fires_on_nonhashable_static_args():
+    findings = lint_source(TRACE_NONHASHABLE_STATIC)
+    assert any(
+        f.code == "TRACE001" and "non-hashable static_argnames" in f.message
+        for f in findings
+    )
+
+
+def test_trace001_silent_on_module_memoization():
+    """Storing the constructed kernel into module state is THE sanctioned
+    shape (_remap_concat / _offset_concat / _JIT_KERNELS idiom)."""
+    assert lint_source(TRACE_MEMOIZED_OK) == []
+
+
+def test_eager001_fires_on_r06_shapes_in_hot_modules():
+    for src in (EAGER_R06_PACK, EAGER_R06_TRANSLATE):
+        (f,) = lint_source(src, "csvplus_tpu/ops/x.py")
+        assert f.code == "EAGER001" and "unfused jnp" in f.message
+
+
+def test_eager001_scoped_to_hot_modules_and_jit_context():
+    # cold module: same source, no finding
+    assert lint_source(EAGER_R06_PACK, "csvplus_tpu/columnar/ingest.py") == []
+    # the fused form — loop under a jit decorator — is no EAGER001 (the
+    # remaining JIT001 about iterating a tuple param is a separate,
+    # correct finding)
+    fused = EAGER_R06_PACK.replace(
+        "def build(", "@jax.jit\ndef build("
+    ).replace("import jax.numpy", "import jax\nimport jax.numpy")
+    codes = {f.code for f in lint_source(fused, "csvplus_tpu/ops/x.py")}
+    assert "EAGER001" not in codes
+
+
+def test_thread001_fires_on_unlocked_shared_state():
+    findings = lint_source(THREAD_SHARED_STATE, "scanner.py")
+    assert len(findings) >= 2  # the global dict store AND the ctx attr
+    assert all(f.code == "THREAD001" for f in findings)
+    assert all("reassembler" in f.message for f in findings)
+
+
+def test_thread001_silent_under_module_lock_or_other_modules():
+    assert lint_source(THREAD_LOCKED_OK, "scanner.py") == []
+    # no worker entry in the module: the rule never activates
+    assert (
+        lint_source(THREAD_SHARED_STATE.replace("_scan_encode_chunk", "f"))
+        == []
+    )
+
+
+def test_hygiene_allowance_lists_start_empty():
+    """Acceptance: the tree is clean WITHOUT allowances; new entries need
+    an explicit review."""
+    from csvplus_tpu.analysis.astlint import (
+        EAGER001_ALLOWED,
+        THREAD001_ALLOWED,
+        TRACE001_ALLOWED,
+    )
+
+    assert TRACE001_ALLOWED == frozenset()
+    assert EAGER001_ALLOWED == frozenset()
+    assert THREAD001_ALLOWED == frozenset()
+
+
+# ---- the `make analyze` snapshot -------------------------------------
+
+
+def test_analyze_payload_matches_committed_snapshot():
+    """json_payload over the example chains must equal the committed
+    snapshot — diagnostic drift is a reviewed diff, not silent."""
+    import json
+
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    from csvplus_tpu.analysis import json_payload
+
+    expected = json.loads(
+        (REPO / "tests" / "data" / "analyze_snapshot.json").read_text()
+    )
+    assert json_payload() == expected

@@ -188,6 +188,36 @@ def check_verifier_verdicts(plan, host, dev):
     if report.predicts_empty:
         assert host == ("rows", []), (host, report.describe())
         assert dev == ("rows", []), (dev, report.describe())
+    # placement contract, checked on mesh-sharded streams (bounding the
+    # re-execution cost to the sharded differential tests).  The
+    # differential vocabulary stays far below PARTITION_MIN_KEYS, so
+    # every sharded probe must land in the benign-broadcast tier: a
+    # placement-flow WARN here would be a false alarm, and conversely a
+    # warn-free clean report must lower and run without host fallback —
+    # a stale ExecutorModel placement flag fails one direction or the
+    # other.
+    first = report.states[0] if report.states else None
+    if first is not None and any(
+        info.placement.is_sharded for info in first.schema.values()
+    ):
+        from csvplus_tpu.columnar.exec import try_execute_plan
+
+        pf_warns = [
+            d for d in report.warnings if d.rule == "placement-flow"
+        ]
+        try:
+            executed = try_execute_plan(plan)
+        except DataSourceError:
+            return  # data-dependent runtime error: contract is vacuous
+        if executed is not None:
+            assert not pf_warns, (pf_warns, report.describe())
+        if (
+            dev[0] == "rows"
+            and not report.errors
+            and not report.warnings
+            and not report.by_rule("data-dependent")
+        ):
+            assert executed is not None, report.describe()
 
 
 @given(tables(), st.lists(stages(), min_size=0, max_size=4))
