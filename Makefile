@@ -1,7 +1,7 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro bench-mesh bench-ingest dryrun example coldcheck lint analyze asan
+.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve dryrun example coldcheck lint analyze asan
 
 test:
 	python -m pytest tests/ -x -q
@@ -78,6 +78,16 @@ bench-mesh:
 # CSVPLUS_BENCH_INGEST_OUT is set.
 bench-ingest:
 	JAX_PLATFORMS=cpu python bench.py --bench-ingest
+
+# Serving-tier gate (docs/SERVING.md): closed-loop coalesced lookups,
+# 32 OS-thread clients, open-loop fixed-rate latency (p50/p99), zipf
+# keys, plan-cache cold/warm (asserts zero warm recompiles), and an
+# overload shed scenario — all on the 1M-row big-index micro shape.
+# One compact JSON line last; exits nonzero on a >2x regression vs
+# bench_serve_floor.json.  The checked-in record (BENCH_SERVE_r08.json)
+# is only (re)written when CSVPLUS_BENCH_SERVE_OUT is set.
+bench-serve:
+	JAX_PLATFORMS=cpu python bench_serve.py
 
 dryrun:
 	python __graft_entry__.py

@@ -42,6 +42,7 @@ from .sinks import to_rows_many
 from .predicates import All, Any_, Like, Not, Predicate
 from .exprs import Rename, SetValue, Update
 from . import plan
+from . import serve
 from .utils import telemetry, profile_to
 
 # Go-style API aliases (reference names; BASELINE.json exercises these)
@@ -90,6 +91,7 @@ __all__ = [
     # helpers
     "merge_rows",
     "plan",
+    "serve",
     "telemetry",
     "profile_to",
     # Go-style aliases

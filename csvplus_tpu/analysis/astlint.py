@@ -313,8 +313,24 @@ THREAD001_ALLOWED: frozenset = frozenset()
 _EAGER_HOT_DIRS = ("ops",)
 _EAGER_HOT_FILES = ("typed.py", "table.py")
 
-# worker entry points whose reachable call graph must stay pure (r07)
-_WORKER_ENTRY_NAMES = ("_scan_encode_chunk",)
+# Cross-thread entry points whose reachable call graph must mutate
+# shared state only under locks: the r07 ingest worker, plus the r08
+# serving tier's dispatcher loop and its caller-side submission path
+# and the serving monitors' mutators (metrics counters/reservoirs, the
+# plan-cache map).  Matching is on the bare name, so class METHODS with
+# these names are entries too (the lint tracks ``self`` as the shared
+# context).
+_WORKER_ENTRY_NAMES = (
+    "_scan_encode_chunk",
+    "_dispatch_loop",
+    "_enqueue",
+    "on_tick",
+    "on_batch",
+    "on_enqueue",
+    "on_shed",
+    "on_complete_batch",
+    "executable_for",
+)
 
 _EAGER_TRANSFORM_OPS = frozenset(
     {
@@ -360,6 +376,11 @@ _MUTATING_METHODS = frozenset(
         "setdefault",
         "sort",
         "reverse",
+        # deque / OrderedDict mutators the serving tier's queues and
+        # LRUs lean on (r08)
+        "popleft",
+        "appendleft",
+        "move_to_end",
     }
 )
 
@@ -691,16 +712,34 @@ def _thread_local_names(tree: ast.Module) -> Set[str]:
 
 def _thread_findings(tree: ast.Module, path: str) -> List[LintFinding]:
     """THREAD001 over one module, active only when it defines a worker
-    entry (``_scan_encode_chunk``).  Walks the same-module call graph
-    from the entry, propagating which parameters alias the SHARED
-    context (the entry's first argument), and flags any mutation of
-    module-global or shared-context state outside a module-level lock's
-    ``with`` block or ``threading.local()`` storage."""
+    entry (:data:`_WORKER_ENTRY_NAMES`; module-level functions AND
+    methods of module-level classes match by bare name).  Walks the
+    same-module call graph from each entry — through plain calls and
+    through ``ctx.method(...)`` calls on a tracked context — propagating
+    which parameters alias the SHARED context (the entry's first
+    argument; ``self`` for a method entry), and flags any mutation of
+    module-global or shared-context state outside a lock's ``with``
+    block or ``threading.local()`` storage.  Recognized guards: a
+    module-level ``Lock``/``RLock`` name, or an attribute of the
+    tracked context / a module global whose terminal name ends in
+    ``lock`` or ``cv`` (``with self._lock:``, ``with ctx._cv:`` — a
+    Condition's ``with`` acquires its underlying lock)."""
     defs: Dict[str, ast.AST] = {}
+    method_index: Dict[str, List[str]] = {}  # bare method name -> "Cls.m" keys
     for stmt in tree.body:
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
             defs[stmt.name] = stmt
-    entries = [n for n in _WORKER_ENTRY_NAMES if n in defs]
+        elif isinstance(stmt, ast.ClassDef):
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{stmt.name}.{sub.name}"
+                    defs[q] = sub
+                    method_index.setdefault(sub.name, []).append(q)
+    entries = [
+        name
+        for name in defs
+        if name.rsplit(".", 1)[-1] in _WORKER_ENTRY_NAMES
+    ]
     if not entries:
         return []
     module_names = _module_level_names(tree)
@@ -717,46 +756,77 @@ def _thread_findings(tree: ast.Module, path: str) -> List[LintFinding]:
     for e in entries:
         ps = params_of(defs[e])
         tracked[e] = {ps[0]} if ps else set()
+
+    def propagate(callee: str, passed: Set[str], work: List[str]) -> None:
+        prev = tracked.get(callee)
+        if prev is None or not passed <= prev:
+            tracked[callee] = (prev or set()) | passed
+            work.append(callee)
+
     work = list(entries)
     while work:
         name = work.pop()
         func = defs[name]
         t = tracked.get(name, set())
         for sub in ast.walk(func):
-            if not (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)):
+            if not isinstance(sub, ast.Call):
                 continue
-            callee = sub.func.id
-            if callee not in defs:
-                continue
-            callee_params = params_of(defs[callee])
-            passed: Set[str] = set()
-            for i, a in enumerate(sub.args):
-                if isinstance(a, ast.Name) and a.id in t and i < len(callee_params):
-                    passed.add(callee_params[i])
-            for kw in sub.keywords:
-                if (
-                    kw.arg is not None
-                    and isinstance(kw.value, ast.Name)
-                    and kw.value.id in t
-                ):
-                    passed.add(kw.arg)
-            prev = tracked.get(callee)
-            if prev is None or not passed <= prev:
-                tracked[callee] = (prev or set()) | passed
-                work.append(callee)
+            callees: List[Tuple[str, int]] = []  # (def key, self offset)
+            if isinstance(sub.func, ast.Name) and sub.func.id in defs:
+                callees.append((sub.func.id, 0))
+            elif (
+                isinstance(sub.func, ast.Attribute)
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id in t
+            ):
+                # ctx.method(...): the receiver IS the shared context —
+                # resolve to every same-module class method of that name
+                # (conservative when classes share a method name)
+                callees.extend(
+                    (q, 1) for q in method_index.get(sub.func.attr, ())
+                )
+            for callee, offset in callees:
+                callee_params = params_of(defs[callee])
+                passed: Set[str] = set()
+                if offset and callee_params:
+                    passed.add(callee_params[0])  # receiver binds self
+                for i, a in enumerate(sub.args):
+                    j = i + offset
+                    if (
+                        isinstance(a, ast.Name)
+                        and a.id in t
+                        and j < len(callee_params)
+                    ):
+                        passed.add(callee_params[j])
+                for kw in sub.keywords:
+                    if (
+                        kw.arg is not None
+                        and isinstance(kw.value, ast.Name)
+                        and kw.value.id in t
+                    ):
+                        passed.add(kw.arg)
+                propagate(callee, passed, work)
 
     findings: List[LintFinding] = []
     for name, ctx_params in tracked.items():
         func = defs[name]
+
+        def _is_lock_expr(expr: ast.expr) -> bool:
+            if isinstance(expr, ast.Name):
+                return expr.id in locks
+            if isinstance(expr, ast.Attribute):
+                root = _root_name(expr)
+                tail = expr.attr
+                return root is not None and (
+                    root in ctx_params or root in module_names
+                ) and (tail.endswith("lock") or tail.endswith("cv"))
+            return False
+
         spans = [
             (w.lineno, getattr(w, "end_lineno", w.lineno))
             for w in ast.walk(func)
             if isinstance(w, ast.With)
-            and any(
-                isinstance(item.context_expr, ast.Name)
-                and item.context_expr.id in locks
-                for item in w.items
-            )
+            and any(_is_lock_expr(item.context_expr) for item in w.items)
         ]
         g = _declared_globals(func)
 
@@ -771,10 +841,11 @@ def _thread_findings(tree: ast.Module, path: str) -> List[LintFinding]:
                     "THREAD001",
                     path,
                     line,
-                    f"`{name}` is reachable from worker "
-                    f"`{_WORKER_ENTRY_NAMES[0]}` and {what} outside a "
-                    "module-level lock — cross-chunk state must live in "
-                    "the reassembler (r07 invariant)",
+                    f"`{name}` is reachable from worker entry "
+                    f"`{'/'.join(sorted(entries))}` and {what} outside a "
+                    "recognized lock — shared mutable state must be "
+                    "lock-guarded or owned by one thread (r07/r08 "
+                    "invariant)",
                 )
             )
 

@@ -150,6 +150,23 @@ def example_plan_reports() -> Dict[str, object]:
         )
     else:
         out["sharded-join"] = "skipped: fewer than 8 visible devices"
+    # the r08 serving tier's plan-query shape: a Lookup leaf (one
+    # contiguous index range) with a downstream filter + projection —
+    # exactly what the plan-executable cache admits, so the snapshot
+    # pins the verdict the cache's admission check relies on.  Needs a
+    # lazy device index (eager ones carry no Lookup plan), hence the
+    # on_device-then-index_on build order.
+    serve_idx = take_rows([Row(r) for r in people]).on_device("cpu").index_on("id")
+    lookup_plan = serve_idx.find("1").plan
+    if lookup_plan is not None:
+        out["serve-lookup-filter"] = verify_plan(
+            P.SelectCols(
+                P.Filter(lookup_plan, Like({"name": "Amelia"})),
+                ("name", "surname"),
+            )
+        )
+    else:
+        out["serve-lookup-filter"] = "skipped: index has no device plan"
     return out
 
 

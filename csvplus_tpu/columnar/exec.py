@@ -129,7 +129,7 @@ def execute_plan(root: P.PlanNode) -> DeviceTable:
     return execute_plan_view(root).materialize()
 
 
-def execute_plan_view(root: P.PlanNode) -> "_View":
+def execute_plan_view(root: P.PlanNode, preverified: bool = False) -> "_View":
     """Run the plan, returning the final executor view (columns +
     selection vector + source row numbering) without materializing.
 
@@ -139,10 +139,18 @@ def execute_plan_view(root: P.PlanNode) -> "_View":
     have mid-execution), and invalid column references are known up
     front rather than discovered one stage at a time.  ``CSVPLUS_VERIFY=0``
     is the escape hatch back to the unverified lowering.
-    """
-    from ..analysis import verify_before_lower
 
-    verify_before_lower(root)
+    ``preverified=True`` skips the verifier hook: the caller vouches
+    that a plan of this exact STRUCTURAL shape already verified clean.
+    The serving tier's plan-executable cache
+    (:mod:`csvplus_tpu.serve.plancache`) is the one legitimate caller —
+    it verifies each shape once at admission and keys the cache so any
+    op/schema/placement change re-verifies.
+    """
+    if not preverified:
+        from ..analysis import verify_before_lower
+
+        verify_before_lower(root)
     stages = _linearize(root)
     # Validate lowers only as the FINAL stage.  Upstream of anything
     # else, the host's push semantics (check rows one by one, stop the

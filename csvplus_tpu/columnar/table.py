@@ -662,6 +662,12 @@ class DeviceTable:
         # that depends on every column (e.g. the fused flagship join's
         # match count): sync() is then a completed fact, not a round trip
         self.already_forced = False
+        # serializes the mirror-decode LRU (rows_from_mirror_many): the
+        # serving tier made concurrent lookups real, and an OrderedDict
+        # being reordered (move_to_end) while another thread inserts or
+        # evicts corrupts it — even cache HITS mutate recency order, so
+        # every access must hold this
+        self._mirror_lock = threading.Lock()
 
     @classmethod
     def from_pylists(
@@ -865,7 +871,20 @@ class DeviceTable:
         Returned blocks share Row objects with the cache (and across
         duplicate ranges) — the same sharing contract as the host tier's
         ``rows[lower:upper]`` slices; ``iterate`` clones on delivery.
+
+        Thread-safe: the whole call holds ``_mirror_lock``.  The serving
+        tier funnels lookups through ONE dispatcher thread, so the lock
+        is normally uncontended — it exists so direct concurrent callers
+        (the r08 stress test, user code sharing an Index across threads)
+        get serialized decodes instead of a corrupted LRU, with results
+        bitwise-equal to the serial order.
         """
+        with self._mirror_lock:
+            return self._rows_from_mirror_many_locked(bounds)
+
+    def _rows_from_mirror_many_locked(
+        self, bounds: Sequence[Tuple[int, int]]
+    ) -> List[List[Row]]:
         lru = getattr(self, "_mirror_lru", None)
         if lru is None:
             from collections import OrderedDict

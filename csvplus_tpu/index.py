@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import bisect
 import json
+import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -59,7 +60,7 @@ class IndexImpl:
     csvplus.go:785-788).  ``rows`` may be lazily backed by a sorted
     device table (``dev``), decoded on first host access."""
 
-    __slots__ = ("_rows", "columns", "_keys", "_probe_map", "dev")
+    __slots__ = ("_rows", "columns", "_keys", "_probe_map", "dev", "_lock")
 
     def __init__(self, rows: Optional[List[Row]], columns: Sequence[str], dev=None):
         self._rows = rows
@@ -70,6 +71,13 @@ class IndexImpl:
         # baseline's map would); prefix probes still bisect
         self._probe_map: "Optional[dict]" = None
         self.dev = dev  # ops.join.DeviceIndex over the sorted columnar copy
+        # serializes the lazy builds (row materialization, key cache,
+        # probe map) under concurrent readers — without it two serving
+        # threads each pay the O(n) build and one result is discarded.
+        # RLock because keys->rows nest.  Probes against an index while
+        # a writer MUTATES it (rows setter / sort / dedup) remain a
+        # caller error; the lock makes concurrent READS safe.
+        self._lock = threading.RLock()
 
     # -- lazy materialization ---------------------------------------------
 
@@ -80,8 +88,10 @@ class IndexImpl:
     @property
     def rows(self) -> List[Row]:
         if self._rows is None:
-            assert self.dev is not None
-            self._rows = self.dev.table.to_rows()
+            with self._lock:
+                if self._rows is None:  # double-checked under the lock
+                    assert self.dev is not None
+                    self._rows = self.dev.table.to_rows()
         return self._rows
 
     @rows.setter
@@ -98,10 +108,13 @@ class IndexImpl:
 
     @property
     def keys(self) -> List[Tuple[str, ...]]:
-        """Per-row key tuples, built lazily and invalidated on mutation."""
+        """Per-row key tuples, built lazily and invalidated on mutation.
+        Concurrent first reads build once under ``_lock``."""
         if self._keys is None:
-            cols = self.columns
-            self._keys = [tuple(r[c] for c in cols) for r in self.rows]
+            with self._lock:
+                if self._keys is None:
+                    cols = self.columns
+                    self._keys = [tuple(r[c] for c in cols) for r in self.rows]
         return self._keys
 
     def _invalidate(self) -> None:
@@ -140,19 +153,22 @@ class IndexImpl:
 
     def _ensure_probe_map(self) -> Dict[Tuple[str, ...], Tuple[int, int]]:
         """Full-width key tuple -> [lower, upper), built lazily in one
-        O(n) sweep and invalidated on mutation."""
+        O(n) sweep (once, under ``_lock``) and invalidated on mutation."""
         pm = self._probe_map
         if pm is None:
-            pm = {}
-            keys = self.keys
-            i, n = 0, len(keys)
-            while i < n:
-                j = i + 1
-                while j < n and keys[j] == keys[i]:
-                    j += 1
-                pm[keys[i]] = (i, j)
-                i = j
-            self._probe_map = pm
+            with self._lock:
+                pm = self._probe_map
+                if pm is None:
+                    pm = {}
+                    keys = self.keys
+                    i, n = 0, len(keys)
+                    while i < n:
+                        j = i + 1
+                        while j < n and keys[j] == keys[i]:
+                            j += 1
+                        pm[keys[i]] = (i, j)
+                        i = j
+                    self._probe_map = pm
         return pm
 
     def bounds_many(

@@ -36,6 +36,7 @@ Key-width tiers (TPUs are 32-bit-native; JAX int64 needs global x64):
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass
 from functools import partial as _partial
 from typing import ClassVar, List, Optional, Sequence, Tuple
@@ -305,6 +306,15 @@ class DeviceIndex:
             key64 |= np.asarray(c.codes).astype(np.int64) << s
         return cls(table, key_columns, None, key64, shifts, bits, hi, lo)
 
+    def __post_init__(self):
+        # serializes the lazy probe-side builds (_packed_host mirror,
+        # _direct_cum table) under the serving tier's concurrent
+        # callers.  Both builds are idempotent — a race would only waste
+        # a duplicate O(n) transfer/cumsum, never corrupt — but at
+        # serving rates the duplicate work is a real latency spike, so
+        # first-touch is serialized like IndexImpl's lazy caches.
+        self._aux_lock = threading.Lock()
+
     @property
     def supported(self) -> bool:
         return self.shifts is not None
@@ -320,10 +330,24 @@ class DeviceIndex:
             return None
         cum = getattr(self, "_direct_cum", None)
         if cum is None:
-            cum = self._direct_cum = _build_direct_cum(
-                self.packed_i32, self.direct_bits
-            )
+            with self._aux_lock:
+                cum = getattr(self, "_direct_cum", None)
+                if cum is None:
+                    cum = self._direct_cum = _build_direct_cum(
+                        self.packed_i32, self.direct_bits
+                    )
         return cum
+
+    def _packed_host_mirror(self) -> np.ndarray:
+        """Host mirror of the sorted packed keys, built once under the
+        lock (the point-lookup tiers' searchsorted target)."""
+        host = getattr(self, "_packed_host", None)
+        if host is None:
+            with self._aux_lock:
+                host = getattr(self, "_packed_host", None)
+                if host is None:
+                    host = self._packed_host = np.asarray(self.packed_i32)
+        return host
 
     def point_bounds(self, values: List[str]) -> Tuple[int, int]:
         """[lower, upper) range for one key-prefix probe — the device form
@@ -354,9 +378,7 @@ class DeviceIndex:
             # the mirror would cost more than it saves, so the device
             # searchsorted remains.
             if int(self.packed_i32.shape[0]) <= self.POINT_MIRROR_MAX_KEYS:
-                host = getattr(self, "_packed_host", None)
-                if host is None:
-                    host = self._packed_host = np.asarray(self.packed_i32)
+                host = self._packed_host_mirror()
                 # keys must match the array dtype: a python-int key makes
                 # numpy promote (copy) the whole array per lookup.  The
                 # one-past-top probe qk + range_size can equal 2^31; it
@@ -425,9 +447,7 @@ class DeviceIndex:
         if self.packed_i32 is not None:
             over = top > np.iinfo(np.int32).max  # one-past-top: upper = n
             if int(self.packed_i32.shape[0]) <= self.POINT_MIRROR_MAX_KEYS:
-                host = getattr(self, "_packed_host", None)
-                if host is None:
-                    host = self._packed_host = np.asarray(self.packed_i32)
+                host = self._packed_host_mirror()
                 lower = host.searchsorted(qk.astype(np.int32), side="left")
                 upper = host.searchsorted(
                     np.where(over, 0, top).astype(np.int32), side="left"

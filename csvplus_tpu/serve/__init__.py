@@ -1,0 +1,47 @@
+"""Concurrent query-serving tier (r08).
+
+Everything below this package is a library that serves exactly one
+caller: ``Index.find_many`` batches lookups *within* one call, the plan
+IR verifies and lowers *per* submission.  This package turns those
+building blocks into a service:
+
+* :mod:`~csvplus_tpu.serve.coalesce` — :class:`LookupServer`: concurrent
+  callers submit single point-lookup probes; one dispatcher thread
+  drains the pending queue into ONE batched ``find_many`` call per
+  cycle and scatters per-key results back to caller futures, so N
+  independent clients approach the batched-engine throughput instead of
+  the single-``find`` rate.
+* :mod:`~csvplus_tpu.serve.plancache` — :class:`PlanCache`: plan-IR
+  queries are verified once at admission (``analysis/verify.py``; a
+  plan with error-severity diagnostics is rejected, never lowered),
+  canonicalized to a structural key (op tree + schema + placement, NOT
+  data), and their verified executables reused so repeated query shapes
+  skip verify+trace+lower.
+* :mod:`~csvplus_tpu.serve.admit` — admission control: bounded pending
+  queue with typed :class:`ServerOverloaded` load-shedding and
+  per-request deadline checks before dispatch.
+* :mod:`~csvplus_tpu.serve.metrics` — :class:`ServingMetrics`: queue
+  depth, batch-size histogram, coalesce ticks, cache hit rate and a
+  p50/p99 latency reservoir, exportable as a JSON snapshot and mirrored
+  into :mod:`csvplus_tpu.utils.observe` stage conventions.
+
+See docs/SERVING.md for the architecture and env knobs.
+"""
+
+from .admit import AdmissionController, DeadlineExceeded, ServerOverloaded
+from .coalesce import LookupServer
+from .metrics import BatchHistogram, LatencyReservoir, ServingMetrics
+from .plancache import PlanCache, PlanRejected, plan_cache_key
+
+__all__ = [
+    "AdmissionController",
+    "BatchHistogram",
+    "DeadlineExceeded",
+    "LatencyReservoir",
+    "LookupServer",
+    "PlanCache",
+    "PlanRejected",
+    "ServerOverloaded",
+    "ServingMetrics",
+    "plan_cache_key",
+]

@@ -1,0 +1,230 @@
+"""Serving metrics: counters, batch histogram, latency reservoir.
+
+The serving tier's observability surface, built on the
+:mod:`csvplus_tpu.utils.observe` conventions: cheap always-on counters
+here (a served request must not pay telemetry's record-keeping), with
+every dispatch cycle ALSO mirrored into the process-global ``telemetry``
+singleton as a ``serve:dispatch`` stage when the caller has enabled it —
+so serving cycles land in the same per-stage table as ingest and join
+stages (``merged_stages`` accumulates their ``_s`` extras).
+
+Everything is exportable as one JSON-safe ``snapshot()`` dict; the bench
+artifact (BENCH_SERVE_r08.json) embeds it per the record-or-postmortem
+contract.
+
+Thread model: a :class:`ServingMetrics` instance is a monitor — every
+mutating method takes the instance lock.  Writers are the dispatcher
+thread (batch/tick/latency) and submitting caller threads (enqueue/shed),
+so lock scope is a few integer bumps, never a device call.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional
+
+#: Bounded latency-sample pool.  4096 samples bound p99 estimation error
+#: well below the noise of a 1-CPU host while keeping snapshots O(1)-ish.
+RESERVOIR_CAP = 4096
+
+
+class LatencyReservoir:
+    """Bounded uniform reservoir of latency samples (seconds).
+
+    Algorithm-R replacement with a SEEDED rng: two runs over the same
+    request stream produce the same p50/p99, keeping bench artifacts
+    reproducible.  Not internally locked — owned and guarded by
+    :class:`ServingMetrics`.
+    """
+
+    __slots__ = ("_samples", "_count", "_cap", "_rng")
+
+    def __init__(self, cap: int = RESERVOIR_CAP, seed: int = 0):
+        self._samples: List[float] = []
+        self._count = 0
+        self._cap = int(cap)
+        self._rng = random.Random(seed)
+
+    def record(self, seconds: float) -> None:
+        self._count += 1
+        if len(self._samples) < self._cap:
+            self._samples.append(seconds)
+        else:
+            j = self._rng.randrange(self._count)
+            if j < self._cap:
+                self._samples[j] = seconds
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def quantile(self, q: float) -> Optional[float]:
+        """The *q*-quantile (0..1) of the sampled latencies, or ``None``
+        when nothing was recorded.  Nearest-rank on the sorted pool."""
+        if not self._samples:
+            return None
+        s = sorted(self._samples)
+        rank = min(len(s) - 1, max(0, int(q * len(s))))
+        return s[rank]
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "count": self._count,
+            "p50_ms": _ms(self.quantile(0.50)),
+            "p90_ms": _ms(self.quantile(0.90)),
+            "p99_ms": _ms(self.quantile(0.99)),
+            "max_ms": _ms(max(self._samples) if self._samples else None),
+        }
+
+
+def _ms(seconds: Optional[float]) -> Optional[float]:
+    return None if seconds is None else round(seconds * 1e3, 4)
+
+
+class BatchHistogram:
+    """Power-of-two histogram of dispatch batch sizes.
+
+    Bucket ``k`` counts batches with ``2**(k-1) < size <= 2**k`` (bucket
+    0 = single-request batches) — the shape that answers "is coalescing
+    actually happening" at a glance.  Guarded by the owning monitor.
+    """
+
+    __slots__ = ("_buckets", "_total_requests", "_batches", "_max")
+
+    def __init__(self):
+        self._buckets: Dict[int, int] = {}
+        self._total_requests = 0
+        self._batches = 0
+        self._max = 0
+
+    def record(self, size: int) -> None:
+        if size <= 0:
+            return
+        k = (size - 1).bit_length()
+        self._buckets[k] = self._buckets.get(k, 0) + 1
+        self._total_requests += size
+        self._batches += 1
+        self._max = max(self._max, size)
+
+    @property
+    def mean(self) -> Optional[float]:
+        if not self._batches:
+            return None
+        return self._total_requests / self._batches
+
+    def snapshot(self) -> Dict[str, object]:
+        mean = self.mean
+        return {
+            "batches": self._batches,
+            "requests": self._total_requests,
+            "mean": None if mean is None else round(mean, 2),
+            "max": self._max,
+            # JSON keys as upper bounds: {"1": n, "2": n, "4": n, ...}
+            "by_size_le": {str(1 << k): v for k, v in sorted(self._buckets.items())},
+        }
+
+
+class ServingMetrics:
+    """Monitor aggregating every serving counter plus the reservoirs.
+
+    ``queue_wait`` samples submit→dispatch time (what admission's
+    deadline checks bound); ``latency`` samples submit→completion (what
+    a caller actually observes).
+    """
+
+    def __init__(self, reservoir_seed: int = 0):
+        self._lock = threading.Lock()
+        self.ticks = 0  # dispatcher drain cycles, incl. empty ones
+        self.enqueued = 0  # requests admitted to the queue
+        self.completed = 0  # results delivered (ok or error)
+        self.shed = 0  # rejected with ServerOverloaded at admission
+        self.expired = 0  # completed with DeadlineExceeded before dispatch
+        self.failed = 0  # completed with any other error
+        self.queue_depth_last = 0  # depth observed at the latest drain
+        self.queue_depth_max = 0
+        self.batches = BatchHistogram()
+        self.latency = LatencyReservoir(seed=reservoir_seed)
+        self.queue_wait = LatencyReservoir(seed=reservoir_seed + 1)
+
+    # -- dispatcher-side ---------------------------------------------------
+
+    def on_tick(self, queue_depth: int) -> None:
+        with self._lock:
+            self.ticks += 1
+            self.queue_depth_last = queue_depth
+            if queue_depth > self.queue_depth_max:
+                self.queue_depth_max = queue_depth
+
+    def on_batch(self, size: int) -> None:
+        with self._lock:
+            self.batches.record(size)
+
+    def on_complete(
+        self, latency_s: float, wait_s: float, outcome: str = "ok"
+    ) -> None:
+        """Record one delivered result.  *outcome* is ``"ok"``,
+        ``"expired"`` or ``"failed"``."""
+        self.on_complete_batch([(latency_s, wait_s, outcome)])
+
+    def on_complete_batch(self, samples) -> None:
+        """Record a whole dispatch cycle's deliveries in ONE lock round
+        — at 100K+ lookups/s a per-request lock acquisition is a
+        measurable slice of the per-key budget.  *samples* is a sequence
+        of ``(latency_s, wait_s, outcome)`` tuples."""
+        with self._lock:
+            for latency_s, wait_s, outcome in samples:
+                self.completed += 1
+                if outcome == "expired":
+                    self.expired += 1
+                elif outcome == "failed":
+                    self.failed += 1
+                self.latency.record(latency_s)
+                self.queue_wait.record(wait_s)
+
+    # -- submit-side -------------------------------------------------------
+
+    def on_enqueue(self) -> None:
+        with self._lock:
+            self.enqueued += 1
+
+    def on_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self, plancache=None) -> Dict[str, object]:
+        """One JSON-safe dict of every counter; pass the server's
+        :class:`~csvplus_tpu.serve.plancache.PlanCache` to embed its
+        hit/miss/evict stats under ``"plancache"``."""
+        with self._lock:
+            out: Dict[str, object] = {
+                "ticks": self.ticks,
+                "enqueued": self.enqueued,
+                "completed": self.completed,
+                "shed": self.shed,
+                "expired": self.expired,
+                "failed": self.failed,
+                "queue_depth_last": self.queue_depth_last,
+                "queue_depth_max": self.queue_depth_max,
+                "batch": self.batches.snapshot(),
+                "latency": self.latency.snapshot(),
+                "queue_wait": self.queue_wait.snapshot(),
+            }
+        if plancache is not None:
+            out["plancache"] = plancache.stats()
+        return out
+
+    def observe_dispatch(self, nreq: int, seconds: float) -> None:
+        """Mirror one dispatch cycle into the process-global telemetry
+        (no-op unless the caller enabled it), using the same stage
+        conventions as ingest/join so ``merged_stages`` folds serving
+        into the one per-stage table."""
+        from ..utils.observe import telemetry
+
+        if telemetry.enabled:
+            telemetry.add_stage(
+                "serve:dispatch", rows_in=nreq, rows_out=nreq, seconds=seconds
+            )
+            telemetry.count("serve.dispatched", nreq)

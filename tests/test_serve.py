@@ -1,0 +1,366 @@
+"""Concurrent query-serving tier (csvplus_tpu.serve, docs/SERVING.md).
+
+Contracts under test:
+
+* coalescing correctness — any mix of concurrent submitters gets rows
+  byte-identical to the matching single ``find`` calls, because the
+  coalesced batch routes through the same ``find_rows_many`` engine;
+* plan-executable cache — structural keys hit across different data
+  (Lookup bounds, predicate-matched rows), miss on any op / schema /
+  placement change, and verifier-REJECTED shapes are never cached;
+* admission control — a full pending queue sheds with
+  :class:`ServerOverloaded`; expired deadlines complete with
+  :class:`DeadlineExceeded` before dispatch; ``stop()`` drains every
+  admitted request;
+* thread-safety of the shared lookup path — N threads hammering
+  ``find_many`` (→ ``bounds_many`` → ``rows_from_mirror_many`` and its
+  LRU) each observe results bitwise-equal to the serial run.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import csvplus_tpu as cp
+from csvplus_tpu import plan as P
+from csvplus_tpu.columnar.table import DeviceTable
+from csvplus_tpu.predicates import Like, Predicate
+from csvplus_tpu.serve import (
+    AdmissionController,
+    DeadlineExceeded,
+    LookupServer,
+    PlanCache,
+    PlanRejected,
+    ServerOverloaded,
+    plan_cache_key,
+)
+
+N_ROWS = 4000
+
+
+def _build(n=N_ROWS, extra_col=False):
+    ids = np.arange(n, dtype=np.int64) * 7 % (n * 3)
+    cols = {
+        "id": np.char.add("c", ids.astype(np.str_)).tolist(),
+        "v": np.arange(n).astype(np.str_).tolist(),
+    }
+    if extra_col:
+        cols["w"] = ["x"] * n
+    t = DeviceTable.from_pylists(cols, device="cpu")
+    return cp.take(t).index_on("id").sync(), ids
+
+
+@pytest.fixture(scope="module")
+def served():
+    return _build()
+
+
+def _probes(ids, n, seed=0):
+    rng = np.random.default_rng(seed)
+    ps = [f"c{int(v)}" for v in rng.choice(ids, n)]
+    ps[::17] = ["nope"] * len(ps[::17])  # sprinkle misses
+    return ps
+
+
+# -- coalescing correctness ------------------------------------------------
+
+
+def test_coalesced_matches_serial(served):
+    idx, ids = served
+    probes = _probes(ids, 300)
+    serial = [idx.find(p).to_rows() for p in probes]
+    with LookupServer(idx) as srv:
+        futs = [srv.submit(p) for p in probes]
+        got = [f.result(timeout=30.0) for f in futs]
+    assert got == serial
+
+
+def test_concurrent_submitters_match_serial(served):
+    idx, ids = served
+    probes = _probes(ids, 400, seed=1)
+    serial = [idx.find(p).to_rows() for p in probes]
+    n_threads = 8
+    per = len(probes) // n_threads
+    results = [None] * n_threads
+
+    with LookupServer(idx) as srv:
+        def worker(slot):
+            chunk = probes[slot * per:(slot + 1) * per]
+            futs = [srv.submit(p) for p in chunk]
+            results[slot] = [f.result(timeout=30.0) for f in futs]
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    flat = [rows for chunk in results for rows in chunk]
+    assert flat == serial[: per * n_threads]
+
+
+def test_blocking_lookup_and_probe_validation(served):
+    idx, ids = served
+    with LookupServer(idx) as srv:
+        assert srv.lookup(f"c{int(ids[3])}") == idx.find(f"c{int(ids[3])}").to_rows()
+        with pytest.raises(ValueError, match="too many columns"):
+            srv.submit(("a", "b"))  # index key is one column wide
+
+
+def test_submit_requires_running_server(served):
+    idx, _ = served
+    srv = LookupServer(idx)
+    with pytest.raises(RuntimeError, match="not running"):
+        srv.submit("c7")
+    srv.start()
+    try:
+        assert srv.submit("c7").result(timeout=30.0) is not None
+    finally:
+        srv.stop()
+    with pytest.raises(RuntimeError, match="not running"):
+        srv.submit("c7")
+
+
+def test_stop_drains_admitted_requests(served):
+    idx, ids = served
+    srv = LookupServer(idx, tick_us=20_000).start()
+    futs = [srv.submit(f"c{int(v)}") for v in ids[:200]]
+    srv.stop()  # must drain, not drop
+    for f, v in zip(futs, ids[:200]):
+        assert f.result(timeout=1.0) == idx.find(f"c{int(v)}").to_rows()
+
+
+# -- admission control -----------------------------------------------------
+
+
+def test_overload_sheds_with_typed_error(served):
+    idx, ids = served
+    # a long held-open tick + tiny bound: the burst must overflow
+    with LookupServer(idx, max_pending=4, tick_us=200_000) as srv:
+        shed, futs = 0, []
+        for v in ids[:64]:
+            try:
+                futs.append(srv.submit(f"c{int(v)}"))
+            except ServerOverloaded as e:
+                shed += 1
+                assert e.pending >= 4 and e.bound == 4
+        assert shed > 0 and len(futs) >= 4
+        for f in futs:  # every ADMITTED request still completes
+            assert f.result(timeout=30.0) is not None
+        assert srv.snapshot()["shed"] == shed
+
+
+def test_deadline_expires_before_dispatch(served):
+    idx, ids = served
+    with LookupServer(idx, tick_us=50_000) as srv:
+        fut = srv.submit(f"c{int(ids[0])}", deadline_s=0.0)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=30.0)
+        ok = srv.submit(f"c{int(ids[0])}")  # no deadline rides the same batch
+        assert ok.result(timeout=30.0) == idx.find(f"c{int(ids[0])}").to_rows()
+        assert srv.snapshot()["expired"] == 1
+
+
+def test_admission_controller_unit():
+    ac = AdmissionController(max_pending=2)
+    ac.admit(0)
+    ac.admit(1)
+    with pytest.raises(ServerOverloaded):
+        ac.admit(2)
+    assert AdmissionController.deadline_error(0.0, None, 100.0) is None
+    assert AdmissionController.deadline_error(0.0, 5.0, 1.0) is None
+    err = AdmissionController.deadline_error(0.0, 5.0, 6.0)
+    assert isinstance(err, DeadlineExceeded)
+
+
+# -- plan-cache keys -------------------------------------------------------
+
+
+class _Opaque(Predicate):
+    """A predicate build_mask cannot lower -> error-severity verifier
+    diagnostic -> the cache must REJECT, not cache."""
+
+    def __call__(self, row):
+        return True
+
+    def __repr__(self):
+        return "_Opaque()"
+
+
+def test_key_identical_structure_different_data(served):
+    idx, ids = served
+    a = idx.find(f"c{int(ids[1])}").plan
+    b = idx.find(f"c{int(ids[2])}").plan
+    assert a is not None and a.lower != b.lower  # genuinely different data
+    assert plan_cache_key(a) == plan_cache_key(b)
+    cache = PlanCache(size=8)
+    cache.execute(a)
+    cache.execute(b)
+    st = cache.stats()
+    assert (st["hits"], st["misses"], st["lowered"]) == (1, 1, 1)
+
+
+def test_key_misses_on_op_change(served):
+    idx, ids = served
+    leaf = idx.find(f"c{int(ids[1])}").plan
+    filtered = P.Filter(leaf, Like({"id": "c7"}))
+    projected = P.SelectCols(leaf, ("id",))
+    keys = {plan_cache_key(leaf), plan_cache_key(filtered), plan_cache_key(projected)}
+    assert len(keys) == 3
+    # and a predicate VALUE change is a data-shape change too (it is
+    # baked into the lowered mask), so it must miss:
+    assert plan_cache_key(filtered) != plan_cache_key(
+        P.Filter(leaf, Like({"id": "c9"}))
+    )
+
+
+def test_key_misses_on_schema_change():
+    idx_a, ids = _build()
+    idx_b, _ = _build(extra_col=True)
+    a = idx_a.find(f"c{int(ids[1])}").plan
+    b = idx_b.find(f"c{int(ids[1])}").plan
+    assert plan_cache_key(a) != plan_cache_key(b)
+
+
+def test_key_misses_on_placement_change():
+    from csvplus_tpu.parallel.mesh import make_mesh
+
+    rows = {"id": [f"c{i}" for i in range(64)], "v": ["1"] * 64}
+    t_cpu = DeviceTable.from_pylists(rows, device="cpu")
+    t_sharded = DeviceTable.from_pylists(rows, device="cpu").with_sharding(
+        make_mesh(8)
+    )
+    assert plan_cache_key(P.Scan(t_cpu)) != plan_cache_key(P.Scan(t_sharded))
+
+
+def test_rejected_plan_never_cached(served):
+    idx, ids = served
+    leaf = idx.find(f"c{int(ids[1])}").plan
+    bad = P.Filter(leaf, _Opaque())
+    cache = PlanCache(size=8)
+    with pytest.raises(PlanRejected) as ei:
+        cache.execute(bad)
+    assert "unlowerable" in str(ei.value)
+    assert len(cache) == 0 and cache.stats()["rejected"] == 1
+    with pytest.raises(PlanRejected):  # re-verified, still not cached
+        cache.execute(bad)
+    st = cache.stats()
+    assert len(cache) == 0 and st["rejected"] == 2 and st["lowered"] == 0
+
+
+def test_plancache_lru_eviction(served):
+    idx, ids = served
+    leaf = idx.find(f"c{int(ids[1])}").plan
+    shapes = [
+        leaf,
+        P.SelectCols(leaf, ("id",)),
+        P.SelectCols(leaf, ("v",)),
+    ]
+    cache = PlanCache(size=2)
+    for s in shapes:
+        cache.execute(s)
+    st = cache.stats()
+    assert len(cache) == 2 and st["evictions"] == 1 and st["misses"] == 3
+
+
+def test_served_plans_zero_recompile_when_warm(served):
+    idx, ids = served
+    plans = [idx.find(f"c{int(v)}").plan for v in ids[:40]]
+    with LookupServer(idx) as srv:
+        for f in [srv.submit_plan(p) for p in plans[:20]]:
+            f.result(timeout=30.0)
+        cold = srv.plancache.stats()
+        for f in [srv.submit_plan(p) for p in plans[20:]]:
+            f.result(timeout=30.0)
+        warm = srv.plancache.stats()
+        # warm pass: all hits, nothing re-verified or re-lowered
+        assert warm["lowered"] == cold["lowered"] == 1
+        assert warm["hits"] - cold["hits"] == 20
+        # and the served result (a materialized DeviceTable) decodes to
+        # the same rows as the direct lookup
+        fut = srv.submit_plan(plans[0])
+        assert cp.take(fut.result(timeout=30.0)).to_rows() == idx.find(
+            f"c{int(ids[0])}"
+        ).to_rows()
+
+
+# -- metrics ---------------------------------------------------------------
+
+
+def test_metrics_snapshot_shape(served):
+    idx, ids = served
+    with LookupServer(idx) as srv:
+        for f in [srv.submit(f"c{int(v)}") for v in ids[:50]]:
+            f.result(timeout=30.0)
+        snap = srv.snapshot()
+    for key in (
+        "ticks", "enqueued", "completed", "shed", "expired", "failed",
+        "queue_depth_last", "queue_depth_max", "batch", "latency",
+        "queue_wait", "plancache",
+    ):
+        assert key in snap, key
+    assert snap["enqueued"] == snap["completed"] == 50
+    assert snap["latency"]["count"] == 50
+    assert snap["batch"]["requests"] == 50
+    import json
+
+    json.dumps(snap)  # JSON-safe end to end
+
+
+# -- shared lookup path under threads (satellite stress) -------------------
+
+
+@pytest.mark.parametrize("drop_lru", [False, True])
+def test_find_many_threaded_bitwise_equal_serial(served, drop_lru):
+    """N threads × M keys through the full batched chain (bounds_many →
+    rows_for_bounds → rows_from_mirror_many + LRU) must each observe
+    results bitwise-equal to the serial run — the r08 locks make the
+    decoded-block LRU safe under concurrent mutation."""
+    idx, ids = served
+    probes = _probes(ids, 250, seed=3)
+    serial = cp.to_rows_many(idx.find_many(probes))
+    mirror = idx._impl.dev.table
+    n_threads = 8
+    out = [None] * n_threads
+    errs = []
+    start = threading.Barrier(n_threads)
+
+    def worker(slot):
+        try:
+            start.wait()
+            for _ in range(3):
+                if drop_lru:
+                    mirror._mirror_lru = None  # force concurrent decode
+                out[slot] = cp.to_rows_many(idx.find_many(probes))
+        except BaseException as e:
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    for got in out:
+        assert got == serial
+
+
+def test_bounds_many_threaded_equal_serial(served):
+    idx, ids = served
+    impl = idx._impl
+    norm = [(p,) for p in _probes(ids, 200, seed=4)]
+    serial = impl.bounds_many(norm)
+    out = [None] * 6
+    start = threading.Barrier(6)
+
+    def worker(slot):
+        start.wait()
+        out[slot] = impl.bounds_many(norm)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for got in out:
+        assert np.array_equal(np.asarray(got), np.asarray(serial))
