@@ -1,7 +1,7 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve dryrun example coldcheck lint analyze asan
+.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve trace-smoke dryrun example coldcheck lint analyze asan
 
 test:
 	python -m pytest tests/ -x -q
@@ -88,6 +88,15 @@ bench-ingest:
 # is only (re)written when CSVPLUS_BENCH_SERVE_OUT is set.
 bench-serve:
 	JAX_PLATFORMS=cpu python bench_serve.py
+
+# Tracing-subsystem smoke (docs/OBSERVABILITY.md): a traced serving
+# pass on the micro lookup shape must produce per-request span trees,
+# the Chrome-trace export must pass the schema validator, and the
+# DISABLED instrumentation path must cost <=2% of the bare batched
+# lookup pass (CSVPLUS_TRACE_SMOKE_MAX_PCT to override).  One JSON
+# line; exits nonzero on any gate failure.
+trace-smoke:
+	JAX_PLATFORMS=cpu python bench.py --trace-smoke
 
 dryrun:
 	python __graft_entry__.py

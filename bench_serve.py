@@ -312,6 +312,7 @@ def main() -> int:
     import jax
 
     from bench import zipf_probe_values
+    from csvplus_tpu.obs.memory import host_header
 
     n = _env_int("CSVPLUS_BENCH_SERVE_ROWS", 1_000_000)
     n_lookups = _env_int("CSVPLUS_BENCH_SERVE_LOOKUPS", 60_000)
@@ -431,7 +432,7 @@ def main() -> int:
         "n_lookups": n_lookups,
         "clients": n_clients,
         "backend": jax.default_backend(),
-        "host_cpus": host_cpus,
+        **host_header(),
         "single_find_lookups_per_sec": single_rate,
         "coalesced_speedup_vs_single": round(headline / single_rate, 2),
         "targets": targets,

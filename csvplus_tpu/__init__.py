@@ -41,6 +41,7 @@ from .index import Index, create_index, create_unique_index, load_index
 from .sinks import to_rows_many
 from .predicates import All, Any_, Like, Not, Predicate
 from .exprs import Rename, SetValue, Update
+from . import obs
 from . import plan
 from . import serve
 from .utils import telemetry, profile_to
@@ -90,6 +91,7 @@ __all__ = [
     "Update",
     # helpers
     "merge_rows",
+    "obs",
     "plan",
     "serve",
     "telemetry",

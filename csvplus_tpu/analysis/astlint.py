@@ -317,9 +317,12 @@ _EAGER_HOT_FILES = ("typed.py", "table.py")
 # shared state only under locks: the r07 ingest worker, plus the r08
 # serving tier's dispatcher loop and its caller-side submission path
 # and the serving monitors' mutators (metrics counters/reservoirs, the
-# plan-cache map).  Matching is on the bare name, so class METHODS with
-# these names are entries too (the lint tracks ``self`` as the shared
-# context).
+# plan-cache map), plus the r09 observability subsystem's entry points
+# (telemetry mutators, the tracer's cross-thread recorders, the kernel
+# registry, and the memory sampler loop — all called from ingest
+# workers, the serve dispatcher, and submitters concurrently).
+# Matching is on the bare name, so class METHODS with these names are
+# entries too (the lint tracks ``self`` as the shared context).
 _WORKER_ENTRY_NAMES = (
     "_scan_encode_chunk",
     "_dispatch_loop",
@@ -330,6 +333,15 @@ _WORKER_ENTRY_NAMES = (
     "on_shed",
     "on_complete_batch",
     "executable_for",
+    # csvplus_tpu/obs + utils/observe entry points (r09)
+    "add_stage",
+    "count",
+    "count_sync",
+    "add_span",
+    "record_span",
+    "drain",
+    "register_kernel",
+    "_sample_loop",
 )
 
 _EAGER_TRANSFORM_OPS = frozenset(

@@ -204,12 +204,16 @@ def execute_plan_view(root: P.PlanNode, preverified: bool = False) -> "_View":
             identity=stored_len == table.nrows,
         )
 
+    from ..obs.span import tracer
     from ..utils.observe import telemetry
 
-    for node in stages[1:]:
-        with telemetry.stage(type(node).__name__, int(view.sel.shape[0])) as _t:
-            view = _exec_stage(view, node)
-            _t["rows_out"] = int(view.sel.shape[0])
+    # grouping span: in a trace, the per-node stages nest under one
+    # plan:execute region instead of sitting flat beside unrelated work
+    with tracer.span("plan:execute", nodes=len(stages) - 1):
+        for node in stages[1:]:
+            with telemetry.stage(type(node).__name__, int(view.sel.shape[0])) as _t:
+                view = _exec_stage(view, node)
+                _t["rows_out"] = int(view.sel.shape[0])
 
     return view
 

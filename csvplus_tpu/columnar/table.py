@@ -29,6 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs.recompile import register_kernel
 from ..row import Row
 
 ABSENT = np.int32(-1)
@@ -592,6 +593,7 @@ class StringColumn:
         return _apply_code_translation(self.codes, jnp.asarray(trans_dev))
 
 
+@register_kernel("table.apply_code_translation")
 @jax.jit
 def _apply_code_translation(codes: jax.Array, trans: jax.Array) -> jax.Array:
     """``trans[codes]`` with negative codes passed through unchanged —
@@ -602,6 +604,7 @@ def _apply_code_translation(codes: jax.Array, trans: jax.Array) -> jax.Array:
     )
 
 
+@register_kernel("table.sync_probe")
 @jax.jit
 def _sync_probe(*code_arrays: jax.Array) -> jax.Array:
     """sum(first element of each array) — a one-scalar dependency on all."""

@@ -49,6 +49,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs.recompile import register_kernel
+
 
 # Sharding-pad sentinel for typed value lanes: INT32_MIN can never be a
 # real cell (csv_pack_int32 bounds |v| <= INT32_MAX), so pad rows are
@@ -326,6 +328,7 @@ class IntColumn:
         return self._translate_by_values(hit)
 
 
+@register_kernel("typed.translate_dense")
 @jax.jit
 def _translate_dense_kernel(values, lo, table):
     is_pad = values == jnp.int32(PAD_VALUE)
@@ -338,6 +341,7 @@ def _translate_dense_kernel(values, lo, table):
     return jnp.where(ok, got, jnp.where(is_pad, jnp.int32(-2), jnp.int32(-1)))
 
 
+@register_kernel("typed.translate_sorted")
 @jax.jit
 def _translate_sorted_kernel(values, sorted_vals, code_of):
     is_pad = values == jnp.int32(PAD_VALUE)
@@ -351,6 +355,7 @@ def _translate_sorted_kernel(values, sorted_vals, code_of):
     )
 
 
+@register_kernel("typed.translate_empty")
 @jax.jit
 def _translate_empty_kernel(values):
     return jnp.where(

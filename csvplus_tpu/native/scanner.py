@@ -1690,6 +1690,19 @@ def stream_encoded_chunks(
         telemetry.add_stage(
             "ingest:reorder-stall", rows, rows, stats["stall"], workers=k_workers
         )
+    # per-worker lane spans: when a trace is active, each worker's total
+    # busy time becomes one span on its own lane, so Perfetto shows the
+    # staged scan+encode overlap instead of one summed bar
+    from ..obs.span import tracer
+
+    if tracer.active():
+        for worker, busy_s in sorted(stats["per_worker"].items()):
+            tracer.add_span(
+                "ingest:encode-worker",
+                float(busy_s),
+                lane=f"ingest-w{worker}",
+                worker=worker,
+            )
 
 
 def _scan_for_reader(reader, path: str):

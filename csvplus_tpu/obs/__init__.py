@@ -1,0 +1,73 @@
+"""First-class observability subsystem (docs/OBSERVABILITY.md).
+
+What grew out of ``utils/observe.py``'s 211-line helper once every hard
+diagnosis (r05 warm join, r06 mesh RSS) turned out to need it:
+
+* :mod:`~csvplus_tpu.obs.span` — hierarchical per-query spans with
+  ``contextvars`` trace isolation (:data:`tracer`);
+* :mod:`~csvplus_tpu.obs.export` — Chrome-trace/Perfetto JSON +
+  span JSON-lines exporters and the trace-smoke schema validator;
+* :mod:`~csvplus_tpu.obs.recompile` — jit-lowering accounting for the
+  registered module-level kernels (:class:`RecompileWatch`);
+* :mod:`~csvplus_tpu.obs.memory` — RSS/device-memory watermark
+  sampling attachable to any span, plus the bench-artifact host header;
+* :mod:`~csvplus_tpu.obs.diff` — the stage-table regression differ
+  behind ``python -m csvplus_tpu.obs diff``.
+
+The legacy ``telemetry`` singleton keeps its API and feeds the same
+machinery: ``telemetry.stage()`` opens a span whenever a trace is
+active in the calling context.
+"""
+
+from .diff import diff_files, diff_stage_tables, load_stage_table
+from .export import (
+    SpanJsonlSink,
+    chrome_trace_events,
+    export_chrome_trace,
+    spans_to_json,
+    validate_chrome_trace,
+    write_chrome_trace,
+    write_spans_jsonl,
+)
+from .memory import (
+    MemoryWatermark,
+    device_memory_stats,
+    host_header,
+    peak_rss_mb,
+    rss_mb,
+    watch_memory,
+)
+from .recompile import (
+    RecompileWatch,
+    compile_counts,
+    register_kernel,
+    registered_kernels,
+)
+from .span import Span, Trace, Tracer, tracer
+
+__all__ = [
+    "Span",
+    "Trace",
+    "Tracer",
+    "tracer",
+    "SpanJsonlSink",
+    "chrome_trace_events",
+    "export_chrome_trace",
+    "spans_to_json",
+    "validate_chrome_trace",
+    "write_chrome_trace",
+    "write_spans_jsonl",
+    "MemoryWatermark",
+    "device_memory_stats",
+    "host_header",
+    "peak_rss_mb",
+    "rss_mb",
+    "watch_memory",
+    "RecompileWatch",
+    "compile_counts",
+    "register_kernel",
+    "registered_kernels",
+    "diff_files",
+    "diff_stage_tables",
+    "load_stage_table",
+]

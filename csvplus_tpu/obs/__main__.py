@@ -1,0 +1,58 @@
+"""CLI for the observability subsystem.
+
+``python -m csvplus_tpu.obs diff A.json B.json [--threshold 2.0]
+[--min-share 0.005] [--key stage_table] [--json] [--fail-on-flag]``
+    Compare two bench artifacts' stage tables and flag stages whose
+    time (or RSS) share moved beyond the threshold — the r05->r06
+    warm-join diagnosis as a command.  ``--fail-on-flag`` exits 2 when
+    anything is flagged (for CI gates); load/shape errors exit 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .diff import DEFAULT_MIN_SHARE, DEFAULT_THRESHOLD, diff_files, format_diff
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m csvplus_tpu.obs")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    d = sub.add_parser("diff", help="diff two artifacts' stage tables")
+    d.add_argument("artifact_a")
+    d.add_argument("artifact_b")
+    d.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD)
+    d.add_argument("--min-share", type=float, default=DEFAULT_MIN_SHARE)
+    d.add_argument("--key", default=None, help="artifact key holding the table")
+    d.add_argument("--json", action="store_true", help="machine output")
+    d.add_argument(
+        "--fail-on-flag",
+        action="store_true",
+        help="exit 2 when any stage is flagged",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        result = diff_files(
+            args.artifact_a,
+            args.artifact_b,
+            threshold=args.threshold,
+            min_share=args.min_share,
+            key=args.key,
+        )
+    except (OSError, ValueError) as e:
+        print(f"obs diff: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(format_diff(result, args.artifact_a, args.artifact_b))
+    if args.fail_on_flag and result["flagged"]:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,129 @@
+"""Compile/recompile accounting for the module-level jitted kernels.
+
+The r06 diagnosis and the serving tier both converged on the same
+invariant: a warm pass over an already-seen shape must lower NOTHING.
+``PlanCache`` asserts it for plan shapes via its ``lowered`` counter;
+this module extends it to every module-level kernel — the exact
+functions whose eager predecessors caused the r05 warm-join regression.
+
+Kernels self-register at definition site::
+
+    @register_kernel("join.pack_qk")
+    @jax.jit
+    def _pack_qk_kernel(...): ...
+
+and :func:`compile_counts` reads each registered function's jit-cache
+entry count (``PjitFunction._cache_size`` — the number of distinct
+lowerings jax holds for it).  A grown count between two snapshots IS a
+(re)compile; :class:`RecompileWatch` packages the
+snapshot/delta/assert-zero workflow the benches and tests use::
+
+    with RecompileWatch() as w:
+        ...warm passes...
+    w.assert_zero()        # raises listing every kernel that lowered
+
+``_cache_size`` is jax-private; :func:`compile_counts` degrades to
+``None`` per kernel when the running jax build lacks it, and
+:class:`RecompileWatch` then treats that kernel as unobservable rather
+than failing the run (record-or-postmortem, not a hard dependency on a
+private API).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_REGISTRY_LOCK = threading.Lock()
+_KERNELS: Dict[str, Any] = {}
+
+
+def register_kernel(name: str) -> Callable:
+    """Decorator: register a jitted callable under *name* for
+    compile-count accounting.  Returns the callable unchanged — zero
+    call-path overhead."""
+
+    def deco(fn):
+        with _REGISTRY_LOCK:
+            _KERNELS[name] = fn
+        return fn
+
+    return deco
+
+
+def registered_kernels() -> Dict[str, Any]:
+    """Name -> jitted callable snapshot of the registry."""
+    with _REGISTRY_LOCK:
+        return dict(_KERNELS)
+
+
+def _cache_size(fn: Any) -> Optional[int]:
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return None
+
+
+def compile_counts() -> Dict[str, Optional[int]]:
+    """Per-kernel count of distinct lowerings jax currently caches
+    (``None`` when the kernel's count is unobservable on this jax)."""
+    return {name: _cache_size(fn) for name, fn in registered_kernels().items()}
+
+
+class RecompileWatch:
+    """Asserts the zero-warm-recompiles invariant over a region.
+
+    Snapshot on ``__enter__``; :meth:`delta` reports every kernel whose
+    lowering count grew (plus the plan cache's ``lowered`` counter when
+    one was passed); :meth:`assert_zero` raises ``AssertionError``
+    naming the offenders.  Kernels registered *inside* the region count
+    from zero — a brand-new kernel compiling in a warm region is a
+    recompile by definition.
+    """
+
+    def __init__(self, plancache=None):
+        self._plancache = plancache
+        self._before: Dict[str, Optional[int]] = {}
+        self._plan_before = 0
+
+    def __enter__(self) -> "RecompileWatch":
+        self._before = compile_counts()
+        if self._plancache is not None:
+            self._plan_before = self._plancache.stats()["lowered"]
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+    def delta(self) -> Dict[str, int]:
+        """Kernels (and ``plancache``) whose lowering count grew since
+        ``__enter__``; empty dict == the invariant held."""
+        out: Dict[str, int] = {}
+        after = compile_counts()
+        for name, n in after.items():
+            if n is None:
+                continue
+            base = self._before.get(name)
+            if base is None:
+                base = 0 if name not in self._before else n
+            if n > base:
+                out[name] = n - base
+        if self._plancache is not None:
+            grew = self._plancache.stats()["lowered"] - self._plan_before
+            if grew > 0:
+                out["plancache"] = grew
+        return out
+
+    def observable(self) -> bool:
+        """False when no registered kernel exposes a cache size (the
+        invariant cannot be checked on this jax build)."""
+        return any(v is not None for v in compile_counts().values())
+
+    def assert_zero(self, context: str = "warm pass") -> None:
+        d = self.delta()
+        if d:
+            detail = ", ".join(f"{k}:+{v}" for k, v in sorted(d.items()))
+            raise AssertionError(
+                f"recompiles during {context}: {detail} — the zero-warm-"
+                "recompiles invariant is broken (r06 regression shape)"
+            )

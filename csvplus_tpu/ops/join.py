@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from ..columnar.table import DeviceTable, StringColumn, same_placement
+from ..obs.recompile import register_kernel
 
 
 def _bits_for(n: int) -> int:
@@ -109,6 +110,7 @@ def _searchsorted2(keys_hi, keys_lo, q_hi, q_lo, side: str = "left"):
     return lo_idx
 
 
+@register_kernel("join.probe_i32pair")
 @jax.jit
 def _probe_kernel_i32pair(keys_hi, keys_lo, q_hi, q_lo, r_hi, r_lo, ok):
     """Wide-key range probe: two lane-pair binary searches (lower at the
@@ -151,6 +153,7 @@ def direct_probe_parts(
     return lower.astype(jnp.int32), counts.astype(jnp.int32)
 
 
+@register_kernel("join.probe_direct")
 @jax.jit
 def _probe_kernel_direct(
     cum: jax.Array, qk: jax.Array, range_size: jax.Array
@@ -158,6 +161,7 @@ def _probe_kernel_direct(
     return direct_probe_parts(cum, qk, range_size)
 
 
+@register_kernel("join.probe_i32")
 @jax.jit
 def _probe_kernel_i32(
     keys: jax.Array, qk: jax.Array, range_size: jax.Array
@@ -175,6 +179,7 @@ def _probe_kernel_i32(
     return lower.astype(jnp.int32), counts.astype(jnp.int32)
 
 
+@register_kernel("join.build_direct_cum")
 @_partial(jax.jit, static_argnames=("total_bits",))
 def _build_direct_cum(keys: jax.Array, total_bits: int) -> jax.Array:
     """cum[j] = number of build keys strictly below j, for every packed
@@ -655,6 +660,7 @@ class DeviceIndex:
         )
 
 
+@register_kernel("join.pack_qk")
 @_partial(jax.jit, static_argnames=("shifts",))
 def _pack_qk_kernel(  # analysis: allow[JIT001] retrace is per join-key ARITY (bounded by the 31-bit pack budget), not per data length
     codes: Tuple[jax.Array, ...], shifts: Tuple[int, ...]
@@ -685,6 +691,7 @@ def expand_matches(
     return probe_ids, build_ids
 
 
+@register_kernel("join.expand")
 @_partial(jax.jit, static_argnames=("padded_total",))
 def _expand_kernel(lower, counts, padded_total: int):
     """Device fan-out expansion with a static output size: an exclusive
@@ -915,6 +922,7 @@ def join_tables(
     return DeviceTable(out_cols, n_out, stream.device)
 
 
+@register_kernel("join.gather_both_sides")
 @jax.jit
 def _gather_both_sides(build_codes, stream_codes, build_ids, probe_ids):  # analysis: allow[JIT001] — arity fixed per pipeline shape
     b_idx = jnp.asarray(build_ids, dtype=jnp.int32)
@@ -925,12 +933,14 @@ def _gather_both_sides(build_codes, stream_codes, build_ids, probe_ids):  # anal
     )
 
 
+@register_kernel("join.gather_cols")
 @jax.jit
 def _gather_cols(codes, ids):  # analysis: allow[JIT001] — arity fixed per pipeline shape
     idx = jnp.asarray(ids, dtype=jnp.int32)
     return tuple(jnp.take(c, idx, axis=0) for c in codes)
 
 
+@register_kernel("join.probe_stats")
 @jax.jit
 def _probe_stats(lower, counts):
     """(total matches, max run length) as one device pair — a single

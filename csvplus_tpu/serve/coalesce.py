@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
+from ..obs.span import tracer
 from ..row import Row
 from ..utils.env import env_int
 from .admit import AdmissionController, DeadlineExceeded
@@ -61,13 +62,18 @@ class ServeFuture:
     """
 
     __slots__ = ("probe", "plan", "deadline_s", "callback", "t_submit",
-                 "t_dispatch", "value", "error", "_event")
+                 "t_dispatch", "trace_ctx", "value", "error", "_event")
 
     def __init__(self, probe, plan, deadline_s, callback):
         self.probe = probe
         self.plan = plan
         self.deadline_s = deadline_s
         self.callback = callback
+        # explicit handoff of the submitter's trace context: the
+        # dispatcher thread attributes this request's queue-wait and
+        # dispatch back into the SUBMITTER's span tree (the r07 rule —
+        # cross-thread state flows by capture, never ambient sharing)
+        self.trace_ctx = tracer.capture()
         self.t_submit = time.perf_counter()
         self.t_dispatch = 0.0
         self.value: Any = None
@@ -252,29 +258,63 @@ class LookupServer:
             else:
                 lookups.append(req)
         if lookups:
+            # find_rows_many decomposed so the coalesced batch's two
+            # phases carry their own timestamps; each request's trace
+            # gets both as batch-shared children of its dispatch span
             try:
-                groups = self._impl.find_rows_many([r.probe for r in lookups])
+                tb0 = time.perf_counter()
+                bounds = self._impl.bounds_many([r.probe for r in lookups])
+                tb1 = time.perf_counter()
+                groups = self._impl.rows_for_bounds(bounds)
+                tb2 = time.perf_counter()
             except Exception as err:
                 for req in lookups:
-                    self._complete(req, None, err, samples)
+                    self._complete(req, None, err, samples, batch_n=len(lookups))
             else:
+                phases = (
+                    ("serve:bounds", tb0, tb1),
+                    ("serve:gather-decode", tb1, tb2),
+                )
                 for req, rows in zip(lookups, groups):
                     # clone on delivery: blocks may be shared with the
                     # mirror LRU (same contract as iterate/_rows_hint)
-                    self._complete(req, [Row(r) for r in rows], None, samples)
+                    self._complete(
+                        req,
+                        [Row(r) for r in rows],
+                        None,
+                        samples,
+                        batch_n=len(lookups),
+                        phases=phases,
+                    )
         for req in plans:
-            try:
-                value = self.plancache.execute(req.plan)
-            except Exception as err:
-                self._complete(req, None, err, samples)
-            else:
-                self._complete(req, value, None, samples)
+            # plans execute under the submitter's adopted context inside
+            # an open dispatch span, so the executor's per-node stages
+            # (telemetry.stage shim) nest inside it in the right trace
+            with tracer.adopt(req.trace_ctx):
+                handle = tracer.open_span(
+                    "serve:dispatch", kind="plan", batch=len(batch)
+                )
+                try:
+                    value = self.plancache.execute(req.plan)
+                except Exception as err:
+                    tracer.close_span(handle, error=True)
+                    self._complete(req, None, err, samples, own_dispatch=True)
+                else:
+                    tracer.close_span(handle)
+                    self._complete(req, value, None, samples, own_dispatch=True)
         self.metrics.on_batch(len(batch))
         self.metrics.on_complete_batch(samples)
         self.metrics.observe_dispatch(len(batch), time.perf_counter() - t0)
 
     def _complete(
-        self, req: ServeFuture, value, error, samples: List[tuple]
+        self,
+        req: ServeFuture,
+        value,
+        error,
+        samples: List[tuple],
+        batch_n: int = 0,
+        phases: Sequence[tuple] = (),
+        own_dispatch: bool = False,
     ) -> None:
         req.value = value
         req.error = error
@@ -287,6 +327,30 @@ class LookupServer:
         samples.append(
             (done - req.t_submit, req.t_dispatch - req.t_submit, outcome)
         )
+        if req.trace_ctx is not None:
+            # attribute the dispatcher's work back into the SUBMITTER's
+            # span tree: queue-wait, then the dispatch window with the
+            # coalesced batch's phases as batch-shared children
+            trace, parent = req.trace_ctx
+            t_disp = req.t_dispatch or done
+            tracer.record_span(
+                trace, parent, "serve:queue-wait", req.t_submit, t_disp
+            )
+            if not own_dispatch:
+                dspan = tracer.record_span(
+                    trace,
+                    parent,
+                    "serve:dispatch",
+                    t_disp,
+                    done,
+                    outcome=outcome,
+                    batch=batch_n,
+                )
+                for name, ts, te in phases:
+                    tracer.record_span(
+                        trace, dspan.span_id, name, ts, te,
+                        shared=batch_n > 1, batch=batch_n,
+                    )
         if req.callback is not None:
             try:
                 req.callback(req)

@@ -1,14 +1,25 @@
-"""Observability: per-stage pipeline telemetry + JAX profiler hooks.
+"""Observability: per-stage telemetry, now a shim over ``csvplus_tpu.obs``.
 
 The reference has no instrumentation at all (SURVEY.md §5: the only
-observability is error line numbers).  A device framework needs more:
+observability is error line numbers).  This module grew from "row
+counts and wall times" into the compatibility surface of a first-class
+subsystem (:mod:`csvplus_tpu.obs`, docs/OBSERVABILITY.md): per-stage
+wall times and row counts, named counters, host-sync accounting — and,
+whenever a span trace is active in the calling context, every stage
+recorded here ALSO opens a span in that trace, so the flat table and
+the hierarchical per-query view come from the same instrumentation
+points:
 
-* :data:`telemetry` — an opt-in collector of per-stage row counts and
-  wall times from the device plan executor and the columnar ingest; cheap
-  enough to leave on in production pipelines (a few host ops per stage,
-  never per row);
-* :func:`profile_to` — context manager around ``jax.profiler.trace`` so a
-  whole pipeline run can be captured for XProf/Perfetto;
+* :data:`telemetry` — opt-in collector of per-stage statistics from the
+  device plan executor, the columnar ingest, the joins, and the serving
+  dispatcher; cheap enough to leave on in production pipelines (a few
+  host ops per stage, never per row).  Mutation is lock-guarded: ingest
+  workers and the serve dispatcher record stages concurrently
+  (THREAD001 covers the entry points);
+* :func:`profile_to` — context manager around ``jax.profiler.trace`` so
+  a whole pipeline run can be captured for XProf/Perfetto; the span
+  exporter (:func:`csvplus_tpu.obs.export.export_chrome_trace`) writes
+  the host-side trace into the same ``log_dir`` so both open together;
 * ``TraceAnnotation`` pass-through so executor stages show up as named
   ranges inside device traces.
 """
@@ -16,9 +27,12 @@ observability is error line numbers).  A device framework needs more:
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List
+
+from ..obs.span import tracer
 
 
 @dataclass
@@ -55,20 +69,28 @@ class Telemetry:
     # not a stage timing — e.g. the plan verifier's diagnostics-per-rule
     # counts ("verify.resolution", "verify.divergence-risk", ...)
     counters: Dict[str, int] = field(default_factory=dict)
+    # mutation guard: ingest workers and the serve dispatcher call
+    # count()/add_stage() concurrently with collecting readers
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def reset(self) -> None:
-        self.records.clear()
-        self.host_sync_elements = 0
-        self.counters.clear()
+        with self._lock:
+            self.records.clear()
+            self.host_sync_elements = 0
+            self.counters.clear()
 
     def count_sync(self, n: int) -> None:
         if self.enabled:
-            self.host_sync_elements += int(n)
+            with self._lock:
+                self.host_sync_elements += int(n)
 
     def count(self, name: str, n: int = 1) -> None:
         """Bump a named counter (no-op unless collection is enabled)."""
         if self.enabled:
-            self.counters[name] = self.counters.get(name, 0) + int(n)
+            with self._lock:
+                self.counters[name] = self.counters.get(name, 0) + int(n)
 
     @contextlib.contextmanager
     def collect(self) -> Iterator[List[StageRecord]]:
@@ -85,36 +107,55 @@ class Telemetry:
     def stage(self, name: str, rows_in: int) -> Iterator[dict]:
         """Record one stage; the body may set ``out['rows_out']``, or set
         ``out['discard'] = True`` to drop the record (e.g. a fast-path
-        tier that declined and handed off to another tier)."""
-        if not self.enabled:
+        tier that declined and handed off to another tier).
+
+        Span shim: when a trace is active in the calling context
+        (:data:`csvplus_tpu.obs.span.tracer`), the stage also opens a
+        child span there — the hierarchical view needs no new call
+        sites.  The span keeps even discarded/failed stages (annotated),
+        because a trace records what HAPPENED, while the table records
+        what counted."""
+        handle = tracer.open_span(name, rows_in=int(rows_in))
+        if not self.enabled and handle is None:
             yield {}
             return
         out: dict = {}
         t0 = time.perf_counter()
-        with _trace_annotation(f"csvplus:{name}"):
-            yield out
-        if out.get("discard"):
+        try:
+            with _trace_annotation(f"csvplus:{name}"):
+                yield out
+        except BaseException:
+            if handle is not None:
+                tracer.close_span(handle, error=True, **out)
+                handle = None
+            raise
+        finally:
+            if handle is not None:
+                tracer.close_span(handle, **out)
+        if out.get("discard") or not self.enabled:
             return
-        self.records.append(
-            StageRecord(
-                stage=name,
-                rows_in=rows_in,
-                rows_out=int(out.get("rows_out", rows_in)),
-                seconds=time.perf_counter() - t0,
-                extra={
-                    k: v
-                    for k, v in out.items()
-                    if k not in ("rows_out", "discard")
-                },
+        with self._lock:
+            self.records.append(
+                StageRecord(
+                    stage=name,
+                    rows_in=rows_in,
+                    rows_out=int(out.get("rows_out", rows_in)),
+                    seconds=time.perf_counter() - t0,
+                    extra={
+                        k: v
+                        for k, v in out.items()
+                        if k not in ("rows_out", "discard")
+                    },
+                )
             )
-        )
 
     def barrier(self, x):
         """``jax.block_until_ready(x)`` when collecting, so async device
         work lands inside the stage that dispatched it and per-stage
-        times are attributable.  A no-op (and zero dispatch-overlap
-        cost) when collection is off — headline timings are measured
-        with telemetry disabled, the per-stage table with it enabled."""
+        times are attributable.  A strict no-op (and zero dispatch-
+        overlap cost) when collection is off — headline timings are
+        measured with telemetry disabled, the per-stage table with it
+        enabled."""
         if self.enabled and x is not None:
             import jax
 
@@ -127,18 +168,21 @@ class Telemetry:
         """Record a PRE-MEASURED stage — for work accumulated across many
         small slices (e.g. per-chunk producer waits or per-shard seals in
         the streaming ingest) where a contextmanager per slice would
-        drown the measurement in bookkeeping.  One record per call."""
+        drown the measurement in bookkeeping.  One record per call; also
+        mirrored as a pre-measured span when a trace is active."""
+        tracer.add_span(name, float(seconds), rows_in=int(rows_in), **extra)
         if not self.enabled:
             return
-        self.records.append(
-            StageRecord(
-                stage=name,
-                rows_in=int(rows_in),
-                rows_out=int(rows_out),
-                seconds=float(seconds),
-                extra=extra,
+        with self._lock:
+            self.records.append(
+                StageRecord(
+                    stage=name,
+                    rows_in=int(rows_in),
+                    rows_out=int(rows_out),
+                    seconds=float(seconds),
+                    extra=extra,
+                )
             )
-        )
 
     def merged_stages(self) -> List[StageRecord]:
         """Records merged by stage name (first-seen order): seconds and
@@ -150,9 +194,11 @@ class Telemetry:
         records).  This is the per-stage table shape the bench artifacts
         carry — a 3-join pipeline records e.g. 'join:translate' once per
         join, but the artifact wants one line per stage kind."""
+        with self._lock:
+            records = list(self.records)
         order: List[str] = []
         merged: Dict[str, StageRecord] = {}
-        for r in self.records:
+        for r in records:
             got = merged.get(r.stage)
             if got is None:
                 order.append(r.stage)
@@ -175,9 +221,44 @@ class Telemetry:
                         got.extra[k] = v
         return [merged[name] for name in order]
 
+    def to_json(self) -> dict:
+        """JSON-safe snapshot: the merged stage table plus counters and
+        host-sync accounting — the exact shape the bench artifacts
+        embed, so drivers stop hand-rolling it."""
+        merged = self.merged_stages()
+        with self._lock:
+            counters = dict(self.counters)
+            host_sync = self.host_sync_elements
+        return {
+            "stage_table": [
+                {
+                    "stage": r.stage,
+                    "rows_in": r.rows_in,
+                    "rows_out": r.rows_out,
+                    "seconds": round(r.seconds, 4),
+                    **r.extra,
+                }
+                for r in merged
+            ],
+            "counters": counters,
+            "host_sync_elements": host_sync,
+        }
+
     def report(self) -> str:
         head = f"{'stage':<24} {'rows in':>12}    {'rows out':<12} {'time':>9}"
-        return "\n".join([head] + [str(r) for r in self.records])
+        with self._lock:
+            records = list(self.records)
+            counters = dict(self.counters)
+            host_sync = self.host_sync_elements
+        lines = [head] + [str(r) for r in records]
+        if counters:
+            lines.append("counters:")
+            lines.extend(
+                f"  {name:<38} {counters[name]:>12}"
+                for name in sorted(counters)
+            )
+        lines.append(f"host_sync_elements: {host_sync}")
+        return "\n".join(lines)
 
 
 telemetry = Telemetry()
@@ -201,7 +282,9 @@ def _trace_annotation(name: str):
 @contextlib.contextmanager
 def profile_to(log_dir: str):
     """Capture a JAX device trace of the enclosed pipeline run for
-    XProf/Perfetto (``jax.profiler.trace``)."""
+    XProf/Perfetto (``jax.profiler.trace``).  Host-side spans exported
+    with :func:`csvplus_tpu.obs.export.export_chrome_trace` into the
+    same ``log_dir`` open alongside it."""
     import jax.profiler
 
     jax.profiler.start_trace(log_dir)

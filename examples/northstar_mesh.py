@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import json
 import os
-import resource
 import sys
 import time
 
@@ -52,7 +51,9 @@ def _ensure_mesh_env() -> None:
 
 
 def _rss_mb() -> float:
-    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    from csvplus_tpu.obs.memory import peak_rss_mb
+
+    return peak_rss_mb()
 
 
 def main() -> None:
@@ -74,6 +75,7 @@ def main() -> None:
 
     from csvplus_tpu import FromFile, Take
     from csvplus_tpu.native.scanner import _ingest_workers
+    from csvplus_tpu.obs.memory import host_header
     from csvplus_tpu.utils.observe import telemetry
 
     assert len(jax.devices()) >= N_SHARDS, jax.devices()
@@ -133,12 +135,19 @@ def main() -> None:
     # work; bench.py's reps contract likewise holds no extra result).
     # The verification copy is re-materialized afterwards.
     result = None
+    from csvplus_tpu.obs.recompile import RecompileWatch
+
     warm_times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        r = joined.to_device_table().sync()
-        warm_times.append(time.perf_counter() - t0)
-        r = None
+    # warm passes must lower NOTHING: every registered kernel's jit
+    # cache is snapshotted before and asserted unchanged after (the r05
+    # regression was exactly warm-path eager/retrace work)
+    with RecompileWatch() as recompiles:
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = joined.to_device_table().sync()
+            warm_times.append(time.perf_counter() - t0)
+            r = None
+    recompiles.assert_zero("mesh warm joins")
     t_warm = min(warm_times)
     print(
         f"3-way join (warm, best of {len(warm_times)}):"
@@ -158,16 +167,7 @@ def main() -> None:
         join_records = list(jrecords)
     t_instrumented = time.perf_counter() - t0
     telemetry.records[:] = ingest_records + join_records
-    stage_table = [
-        {
-            "stage": r.stage,
-            "rows_in": r.rows_in,
-            "rows_out": r.rows_out,
-            "seconds": round(r.seconds, 4),
-            **r.extra,
-        }
-        for r in telemetry.merged_stages()
-    ]
+    stage_table = telemetry.to_json()["stage_table"]
     telemetry.reset()
     print(
         f"3-way join (instrumented warm pass): {t_instrumented:,.2f}s;"
@@ -240,6 +240,9 @@ def main() -> None:
                 "n_shards": N_SHARDS,
                 "ingest_workers": _ingest_workers(),
                 "backend": jax.default_backend(),
+                **host_header(),
+                "recompiles_warm": recompiles.delta(),
+                "recompiles_observable": recompiles.observable(),
                 "ingest_rows_per_sec": round(n_orders / t_ingest, 1),
                 "join_rows_per_sec": round(n_orders / t_join, 1),
                 "join_rows_per_sec_warm": round(n_orders / t_warm, 1),

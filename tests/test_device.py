@@ -768,7 +768,10 @@ def test_telemetry_report_format(dev_people):
     lines = report.splitlines()
     assert lines[0].split() == ["stage", "rows", "in", "rows", "out", "time"]
     assert any("Filter" in l and "120" in l and "12" in l for l in lines[1:])
-    assert all(l.rstrip().endswith("ms") for l in lines[1:])
+    # stage rows end with a time; the report closes with the accounting
+    # trailer (counters when any, always host_sync_elements)
+    assert lines[-1].startswith("host_sync_elements:")
+    assert all(l.rstrip().endswith("ms") for l in lines[1:-1] if not l.startswith(("counters:", "  ")))
 
 
 class _SyncCountingNp:

@@ -1,0 +1,637 @@
+"""The observability subsystem (csvplus_tpu.obs, docs/OBSERVABILITY.md).
+
+Contracts under test:
+
+* span trees — parenting, contextvars isolation: N concurrent queries
+  produce NON-interleaved per-query traces whose shapes match the
+  serial run exactly (the failure mode that motivated the subsystem);
+* the ``telemetry.stage`` compatibility shim — every existing stage
+  call site doubles as a span when a trace is active, with discarded
+  and failed stages kept (annotated) in the trace;
+* the serving tier's per-request attribution — queue-wait and dispatch
+  land in each SUBMITTER's trace with the coalesced batch's
+  bounds/gather-decode phases as shared children;
+* exporters — Chrome-trace JSON passes its own schema validator and
+  carries every span; the JSON-lines sink drains incrementally;
+* recompile accounting — registered kernels report zero lowerings over
+  a warm repeat and nonzero when a new shape lowers;
+* memory watermarks — the sampler observes a forced RSS excursion and
+  writes its summary into span/stage attrs;
+* the stage-table differ — on the checked-in r05/r06 mesh artifacts it
+  flags exactly the stages the r06 diagnosis found (join:translate,
+  join:pack), plus synthetic direction/threshold/min-share cases;
+* telemetry hygiene — lock-guarded counters under thread hammering,
+  ``merged_stages`` accumulable-extras, ``barrier`` as a strict no-op
+  when disabled, and ``report``/``to_json`` carrying counters +
+  host_sync_elements.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import csvplus_tpu as cp
+from csvplus_tpu.columnar.table import DeviceTable
+from csvplus_tpu.obs import (
+    RecompileWatch,
+    SpanJsonlSink,
+    chrome_trace_events,
+    compile_counts,
+    diff_stage_tables,
+    host_header,
+    peak_rss_mb,
+    register_kernel,
+    registered_kernels,
+    rss_mb,
+    tracer,
+    validate_chrome_trace,
+    watch_memory,
+    write_chrome_trace,
+)
+from csvplus_tpu.obs.diff import diff_files, format_diff
+from csvplus_tpu.obs.__main__ import main as obs_main
+from csvplus_tpu.serve import LookupServer
+from csvplus_tpu.utils.observe import StageRecord, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    # process-global singletons: scrub between tests
+    tracer.reset()
+    telemetry.reset()
+    yield
+    tracer.reset()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# span trees
+# ---------------------------------------------------------------------------
+
+
+def test_span_tree_parenting_and_attrs():
+    with tracer.trace("q", user="t") as tr:
+        with tracer.span("outer", k=1) as attrs:
+            attrs["rows"] = 7
+            with tracer.span("inner"):
+                pass
+        with tracer.span("sibling"):
+            pass
+    spans = {s.name: s for s in tr.snapshot()}
+    assert set(spans) == {"q", "outer", "inner", "sibling"}
+    root = spans["q"]
+    assert root.parent_id is None and root.attrs == {"user": "t"}
+    assert spans["outer"].parent_id == root.span_id
+    assert spans["inner"].parent_id == spans["outer"].span_id
+    assert spans["sibling"].parent_id == root.span_id
+    assert spans["outer"].attrs == {"k": 1, "rows": 7}
+    for s in spans.values():
+        assert s.t_end >= s.t_start
+    assert tracer.finished() == [tr]
+
+
+def test_span_error_annotated_and_raised():
+    with pytest.raises(ValueError):
+        with tracer.trace("q") as tr:
+            with tracer.span("body"):
+                raise ValueError("boom")
+    body = [s for s in tr.snapshot() if s.name == "body"]
+    assert body and body[0].attrs["error"] == "ValueError"
+
+
+def test_no_active_trace_is_a_cheap_noop():
+    assert not tracer.active()
+    assert tracer.open_span("x") is None
+    with tracer.span("x") as attrs:
+        attrs["ignored"] = 1  # throwaway dict, nothing recorded
+    assert tracer.add_span("x", 0.1) is None
+    assert tracer.finished() == []
+
+
+def test_stage_shim_opens_spans_and_keeps_discards():
+    with tracer.trace("pipeline") as tr:
+        with telemetry.stage("work", 10) as out:
+            out["rows_out"] = 9
+        with telemetry.stage("declined", 10) as out:
+            out["discard"] = True
+        with pytest.raises(RuntimeError):
+            with telemetry.stage("failed", 1):
+                raise RuntimeError
+    names = [s.name for s in tr.snapshot()]
+    # the trace records what HAPPENED: discarded and failed stages stay
+    assert names.count("work") == 1
+    assert names.count("declined") == 1
+    failed = [s for s in tr.snapshot() if s.name == "failed"]
+    assert failed[0].attrs.get("error") is True
+    # ...but the flat table still records only what counted (telemetry
+    # was disabled here, so nothing landed at all)
+    assert telemetry.records == []
+
+
+def test_add_stage_mirrors_premeasured_span():
+    with tracer.trace("pipeline") as tr:
+        telemetry.add_stage("bulk", 100, 100, 0.25, chunks=4)
+    bulk = [s for s in tr.snapshot() if s.name == "bulk"]
+    assert len(bulk) == 1
+    assert bulk[0].seconds == pytest.approx(0.25, abs=1e-6)
+    assert bulk[0].attrs["chunks"] == 4
+
+
+def _run_query(i, n_stages=4):
+    """One synthetic traced query; returns its Trace."""
+    with tracer.trace(f"query-{i}", q=i) as tr:
+        for j in range(n_stages):
+            with telemetry.stage(f"stage-{j}", i) as out:
+                out["rows_out"] = i + j
+    return tr
+
+
+def _tree_shape(tr):
+    """(name, parent-name, rows_out) triples, order-independent."""
+    by_id = {s.span_id: s for s in tr.snapshot()}
+    return sorted(
+        (s.name, by_id[s.parent_id].name if s.parent_id else None,
+         s.attrs.get("rows_out"))
+        for s in by_id.values()
+    )
+
+
+def test_concurrent_traces_isolated_and_match_serial():
+    """ACCEPTANCE: N threads' concurrent queries produce non-interleaved
+    per-query span trees with correct parenting, identical in shape and
+    totals to the same queries run serially."""
+    n_threads = 8
+    serial = [_tree_shape(_run_query(i)) for i in range(n_threads)]
+    tracer.reset()
+
+    results = [None] * n_threads
+    barrier = threading.Barrier(n_threads)
+
+    def worker(i):
+        barrier.wait()  # maximize interleaving
+        results[i] = _run_query(i)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert len(tracer.finished()) == n_threads
+    for i, tr in enumerate(results):
+        spans = tr.snapshot()
+        # no foreign spans leaked in: every span carries THIS trace's id
+        assert all(s.trace_id == tr.trace_id for s in spans)
+        assert len(spans) == 5  # root + 4 stages, nothing interleaved
+        # identical tree shape and per-stage totals to the serial run
+        assert _tree_shape(tr) == serial[i]
+
+
+# ---------------------------------------------------------------------------
+# serving-tier per-request spans
+# ---------------------------------------------------------------------------
+
+
+def _build_index(n=2000):
+    ids = np.arange(n, dtype=np.int64) * 7 % (n * 3)
+    t = DeviceTable.from_pylists(
+        {
+            "id": np.char.add("c", ids.astype(np.str_)).tolist(),
+            "v": np.arange(n).astype(np.str_).tolist(),
+        },
+        device="cpu",
+    )
+    return cp.take(t).index_on("id").sync(), ids
+
+
+def test_serve_per_request_span_trees():
+    idx, ids = _build_index()
+    n_clients = 6
+    traces = [None] * n_clients
+    with LookupServer(idx) as srv:
+        barrier = threading.Barrier(n_clients)
+
+        def client(i):
+            barrier.wait()
+            with tracer.trace(f"client-{i}") as tr:
+                rows = srv.submit(f"c{int(ids[i])}").result(timeout=30)
+                assert rows
+            traces[i] = tr
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    for tr in traces:
+        spans = tr.snapshot()
+        by_id = {s.span_id: s for s in spans}
+        root = tr.root()
+        names = [s.name for s in spans]
+        # exactly one queue-wait + one dispatch per request, parented
+        # under the SUBMITTER's root — not interleaved across clients
+        assert names.count("serve:queue-wait") == 1
+        assert names.count("serve:dispatch") == 1
+        qw = next(s for s in spans if s.name == "serve:queue-wait")
+        dsp = next(s for s in spans if s.name == "serve:dispatch")
+        assert qw.parent_id == root.span_id
+        assert dsp.parent_id == root.span_id
+        assert qw.t_start <= dsp.t_start  # queue-wait precedes dispatch
+        assert dsp.attrs["outcome"] == "ok"
+        # the coalesced batch's phases are children of the dispatch span
+        phases = [
+            s for s in spans
+            if s.name in ("serve:bounds", "serve:gather-decode")
+        ]
+        assert len(phases) == 2
+        assert all(by_id[s.parent_id] is dsp for s in phases)
+
+
+def test_serve_plan_spans_nest_executor_stages():
+    idx, ids = _build_index()
+    from csvplus_tpu import plan as P
+
+    leaf = idx.find(f"c{int(ids[1])}").plan
+    node = P.SelectCols(leaf, ("id",))
+    with LookupServer(idx) as srv:
+        with tracer.trace("plan-client") as tr:
+            out = srv.submit_plan(node).result(timeout=30)
+            assert cp.take(out).to_rows()
+    spans = tr.snapshot()
+    by_id = {s.span_id: s for s in spans}
+    assert any(s.name == "serve:queue-wait" for s in spans)
+    dsp = next(s for s in spans if s.name == "serve:dispatch")
+    assert dsp.attrs["kind"] == "plan"
+    # the executor's plan:execute grouping span runs INSIDE the adopted
+    # dispatch span, in the submitter's trace
+    pe = next(s for s in spans if s.name == "plan:execute")
+    assert by_id[pe.parent_id] is dsp
+    # and the per-node stages (telemetry.stage shim) nest under it
+    sel = next(s for s in spans if s.name == "SelectCols")
+    assert by_id[sel.parent_id] is pe
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_export_validates(tmp_path):
+    with tracer.trace("run") as tr:
+        with tracer.span("a", rows=3):
+            with tracer.span("b"):
+                pass
+        telemetry.add_stage("lane-work", 10, 10, 0.01)
+    path = write_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        obj = json.load(f)
+    assert validate_chrome_trace(obj) == []
+    events = obj["traceEvents"]
+    x = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in x} == {"run", "a", "b", "lane-work"}
+    # span identity survives into args; parenting is reconstructible
+    b = next(e for e in x if e["name"] == "b")
+    a = next(e for e in x if e["name"] == "a")
+    assert b["args"]["parent_id"] == a["args"]["span_id"]
+    assert a["args"]["rows"] == 3
+    assert all(e["ts"] >= 0 for e in x)
+    # metadata names the process and every lane
+    m = [e for e in events if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in m)
+    assert len(tr.snapshot()) == len(x)
+
+
+def test_chrome_trace_validator_catches_malformed():
+    assert validate_chrome_trace({"nope": 1})
+    assert validate_chrome_trace(42)
+    bad_events = [
+        {"ph": "X", "ts": 0, "pid": 1, "tid": 1, "dur": 1},  # no name
+        {"name": "n", "ph": "X", "ts": 0, "pid": 1, "tid": 1},  # no dur
+        {"name": "n", "ph": "X", "ts": -5, "pid": 1, "tid": 1, "dur": 1},
+        {"name": "n", "ph": "M", "pid": 1, "tid": 1},  # no args
+    ]
+    problems = validate_chrome_trace(bad_events)
+    assert len(problems) == 4
+    # a correct payload — including ts-less metadata — is clean
+    assert validate_chrome_trace(
+        [
+            {"name": "process_name", "ph": "M", "pid": 1, "tid": 0, "args": {}},
+            {"name": "s", "ph": "X", "ts": 0.0, "dur": 1.0, "pid": 1, "tid": 1},
+        ]
+    ) == []
+
+
+def test_spans_jsonl_sink_drains_incrementally(tmp_path):
+    sink = SpanJsonlSink(str(tmp_path / "spans.jsonl"))
+    with tracer.trace("one"):
+        pass
+    assert sink.flush() == 1
+    assert sink.flush() == 0  # drained: nothing new
+    with tracer.trace("two"):
+        with tracer.span("child"):
+            pass
+    assert sink.flush() == 2
+    rows = [json.loads(l) for l in open(sink.path)]
+    assert {r["name"] for r in rows} == {"one", "two", "child"}
+    assert sink.written == 3
+    assert tracer.finished() == []  # drained out of the tracer
+
+
+def test_chrome_trace_events_empty_without_spans():
+    assert chrome_trace_events([]) == []
+
+
+# ---------------------------------------------------------------------------
+# recompile accounting
+# ---------------------------------------------------------------------------
+
+
+def test_registered_kernels_cover_the_warm_path_modules():
+    import csvplus_tpu.columnar.table  # noqa: F401 — registration side effect
+    import csvplus_tpu.columnar.typed  # noqa: F401
+    import csvplus_tpu.ops.join  # noqa: F401
+
+    names = set(registered_kernels())
+    # the exact kernels whose eager predecessors caused the r05 warm
+    # regression must be accounted
+    for k in (
+        "typed.translate_dense",
+        "typed.translate_sorted",
+        "join.pack_qk",
+        "table.apply_code_translation",
+    ):
+        assert k in names, k
+
+
+def test_recompile_watch_zero_when_warm_and_counts_new_shapes():
+    import jax
+    import jax.numpy as jnp
+
+    @register_kernel("test.obs_kernel")
+    @jax.jit
+    def k(x):
+        return x + 1
+
+    try:
+        k(jnp.arange(4))  # cold: lowers once
+        with RecompileWatch() as w:
+            k(jnp.arange(4))  # warm: same shape, no lowering
+            k(jnp.arange(4))
+        assert w.observable()
+        assert w.delta() == {}
+        w.assert_zero()
+
+        with RecompileWatch() as w2:
+            k(jnp.arange(8))  # NEW shape: one lowering
+        assert w2.delta() == {"test.obs_kernel": 1}
+        with pytest.raises(AssertionError, match="test.obs_kernel"):
+            w2.assert_zero("test region")
+        assert compile_counts()["test.obs_kernel"] == 2
+    finally:
+        from csvplus_tpu.obs import recompile as _r
+
+        with _r._REGISTRY_LOCK:
+            _r._KERNELS.pop("test.obs_kernel", None)
+
+
+def test_recompile_watch_tracks_plancache_lowered():
+    class FakeCache:
+        def __init__(self):
+            self.n = 0
+
+        def stats(self):
+            return {"lowered": self.n}
+
+    fc = FakeCache()
+    with RecompileWatch(plancache=fc) as w:
+        fc.n += 2
+    assert w.delta()["plancache"] == 2
+
+
+# ---------------------------------------------------------------------------
+# memory watermarks
+# ---------------------------------------------------------------------------
+
+
+def test_rss_probes_report_positive_mb():
+    cur, peak = rss_mb(), peak_rss_mb()
+    assert cur > 0
+    assert peak >= cur * 0.5  # same order; VmHWM can't be far below current
+
+
+def test_watch_memory_observes_an_rss_excursion():
+    with tracer.trace("mem") as tr:
+        with tracer.span("alloc") as attrs:
+            with watch_memory(attrs, interval_s=0.002):
+                ballast = np.ones((64, 1 << 20), dtype=np.uint8)  # 64MB
+                time.sleep(0.05)
+                ballast[:] = 7  # touch every page
+                del ballast
+    alloc = next(s for s in tr.snapshot() if s.name == "alloc")
+    a = alloc.attrs
+    assert a["rss_samples"] >= 1
+    assert a["rss_peak_mb"] >= a["rss_start_mb"]
+    assert a["watched_s"] > 0
+
+
+def test_host_header_shape():
+    h = host_header()
+    assert h["host_cpus"] >= 1
+    assert h["platform"] == "cpu"
+    assert h["jax_device_count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# stage-table differ
+# ---------------------------------------------------------------------------
+
+
+def test_diff_flags_the_r05_r06_warm_join_regression():
+    """ACCEPTANCE: the differ reproduces the r06 diagnosis mechanically —
+    join:translate and join:pack are the flagged stages, regressed in
+    the r05 (pre-fix) artifact, and nothing else crosses 2x."""
+    result = diff_files(
+        os.path.join(REPO, "NORTHSTAR_MESH_r05.json"),
+        os.path.join(REPO, "NORTHSTAR_MESH_r06.json"),
+    )
+    flagged = {r["stage"]: r for r in result["flagged"]}
+    assert set(flagged) == {"join:translate", "join:pack"}
+    assert all(r["regressed_in"] == "A" for r in flagged.values())
+    assert flagged["join:pack"]["movement"] > flagged["join:translate"]["movement"]
+    assert result["only_in_a"] == [] and result["only_in_b"] == []
+    # the per-row metric is what crosses tiers: 10M-row vs 100M-row runs
+    assert flagged["join:translate"]["ns_per_row_a"] > flagged[
+        "join:translate"
+    ]["ns_per_row_b"]
+    report = format_diff(result, "r05", "r06")
+    assert "REGRESSED in A" in report
+
+
+def test_diff_direction_threshold_and_min_share():
+    a = [
+        {"stage": "big", "rows_in": 1000, "seconds": 1.0},
+        {"stage": "fast", "rows_in": 1000, "seconds": 0.30},
+        {"stage": "tiny", "rows_in": 1000, "seconds": 0.001},
+        {"stage": "gone", "rows_in": 10, "seconds": 0.01},
+    ]
+    b = [
+        {"stage": "big", "rows_in": 1000, "seconds": 1.0},
+        {"stage": "fast", "rows_in": 1000, "seconds": 0.90},  # 3x slower in B
+        {"stage": "tiny", "rows_in": 1000, "seconds": 0.008},  # 8x but tiny
+        {"stage": "new", "rows_in": 10, "seconds": 0.01},
+    ]
+    r = diff_stage_tables(a, b)
+    flagged = {x["stage"]: x for x in r["flagged"]}
+    assert set(flagged) == {"fast"}
+    assert flagged["fast"]["regressed_in"] == "B"
+    assert r["only_in_a"] == ["gone"] and r["only_in_b"] == ["new"]
+    # "tiny" moved 8x but is under min_share on both sides
+    tiny = next(x for x in r["rows"] if x["stage"] == "tiny")
+    assert tiny["movement"] >= 7 and not tiny["flagged"]
+    # a looser threshold does not resurrect it; a lower min_share does
+    assert {
+        x["stage"] for x in diff_stage_tables(a, b, min_share=0.0)["flagged"]
+    } == {"fast", "tiny"}
+    assert diff_stage_tables(a, b, threshold=4.0)["flagged"] == []
+
+
+def test_diff_rss_column_participates():
+    a = [{"stage": "s", "rows_in": 10, "seconds": 1.0, "rss_peak_mb": 100}]
+    b = [{"stage": "s", "rows_in": 10, "seconds": 1.0, "rss_peak_mb": 500}]
+    r = diff_stage_tables(a, b)
+    assert [x["stage"] for x in r["flagged"]] == ["s"]
+    assert r["flagged"][0]["rss_peak_mb_b"] == 500
+
+
+def test_obs_cli_diff(tmp_path, capsys):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({"stage_table": [
+        {"stage": "s", "rows_in": 10, "seconds": 1.0}]}))
+    b.write_text(json.dumps({"stage_table": [
+        {"stage": "s", "rows_in": 10, "seconds": 5.0}]}))
+    assert obs_main(["diff", str(a), str(b), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    # equal shares (each side's only stage) — the per-row metric flags
+    assert out["flagged"][0]["stage"] == "s"
+    assert out["flagged"][0]["regressed_in"] == "B"
+    assert obs_main(["diff", str(a), str(b), "--fail-on-flag"]) == 2
+    assert obs_main(["diff", str(a), str(tmp_path / "missing.json")]) == 1
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    assert obs_main(["diff", str(a), str(bad)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry hygiene (the satellite fixes)
+# ---------------------------------------------------------------------------
+
+
+def test_report_includes_counters_and_host_sync():
+    with telemetry.collect():
+        with telemetry.stage("s1", 10) as out:
+            out["rows_out"] = 5
+        telemetry.count("verify.resolution", 3)
+        telemetry.count("verify.resolution")
+        telemetry.count_sync(17)
+        rep = telemetry.report()
+    assert "s1" in rep
+    assert "counters:" in rep and "verify.resolution" in rep and "4" in rep
+    assert "host_sync_elements: 17" in rep
+
+
+def test_to_json_shape_matches_artifact_embedding():
+    with telemetry.collect():
+        with telemetry.stage("s1", 10) as out:
+            out["rows_out"] = 5
+            out["tier"] = "direct"
+        telemetry.count("c", 2)
+        telemetry.count_sync(3)
+        got = telemetry.to_json()
+    assert got["counters"] == {"c": 2}
+    assert got["host_sync_elements"] == 3
+    (row,) = got["stage_table"]
+    assert row["stage"] == "s1" and row["rows_in"] == 10
+    assert row["rows_out"] == 5 and row["tier"] == "direct"
+    assert isinstance(row["seconds"], float)
+    json.dumps(got)  # JSON-safe end to end
+
+
+def test_merged_stages_accumulable_extras_rule():
+    with telemetry.collect():
+        telemetry.add_stage("ingest:encode", 10, 10, 0.5,
+                            workers=4, scan_s=0.2, chunks=3)
+        telemetry.add_stage("ingest:encode", 20, 20, 1.0,
+                            workers=4, scan_s=0.3, chunks=5)
+        telemetry.add_stage("other", 1, 1, 0.1)
+        merged = telemetry.merged_stages()
+    assert [m.stage for m in merged] == ["ingest:encode", "other"]
+    enc = merged[0]
+    assert (enc.rows_in, enc.rows_out) == (30, 30)
+    assert enc.seconds == pytest.approx(1.5)
+    # *_s and chunks accumulate; config-shaped extras take last-wins
+    assert enc.extra["scan_s"] == pytest.approx(0.5)
+    assert enc.extra["chunks"] == 8
+    assert enc.extra["workers"] == 4
+
+
+def test_barrier_strict_noop_when_disabled(monkeypatch):
+    import jax
+
+    calls = []
+    monkeypatch.setattr(
+        jax, "block_until_ready", lambda x: calls.append(x) or x
+    )
+    assert not telemetry.enabled
+    x = object()
+    assert telemetry.barrier(x) is x
+    assert calls == []  # disabled: jax is never touched
+    with telemetry.collect():
+        telemetry.barrier(x)
+    assert calls == [x]
+    assert telemetry.barrier(None) is None  # None never dispatches
+
+
+def test_telemetry_mutators_are_thread_safe():
+    n_threads, per = 8, 500
+    with telemetry.collect():
+        barrier = threading.Barrier(n_threads)
+
+        def worker():
+            barrier.wait()
+            for _ in range(per):
+                telemetry.count("hits")
+                telemetry.count_sync(2)
+                telemetry.add_stage("w", 1, 1, 0.001)
+
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert telemetry.counters["hits"] == n_threads * per
+        assert telemetry.host_sync_elements == 2 * n_threads * per
+        assert len(telemetry.records) == n_threads * per
+        (merged,) = telemetry.merged_stages()
+        assert merged.rows_in == n_threads * per
+
+
+def test_stage_record_str_and_collect_reset():
+    r = StageRecord("s", 1, 2, 0.5)
+    assert "s" in str(r)
+    with telemetry.collect() as records:
+        telemetry.count("x")
+        with telemetry.stage("a", 1):
+            pass
+        assert len(records) == 1
+    # collect() restores the previous enabled state
+    assert not telemetry.enabled
