@@ -1,10 +1,14 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve trace-smoke dryrun example coldcheck lint analyze asan
+.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve trace-smoke chaos check dryrun example coldcheck lint analyze asan
 
 test:
 	python -m pytest tests/ -x -q
+
+# The standing local gate: unit suite, static analysis, chaos
+# differential — the set a change must keep green before review.
+check: test lint chaos
 
 # Static analysis gate (docs/ANALYSIS.md).  The repo AST lint (ctypes
 # boundary + jit retrace rules) always runs; ruff and mypy run when
@@ -97,6 +101,19 @@ bench-serve:
 # line; exits nonzero on any gate failure.
 trace-smoke:
 	JAX_PLATFORMS=cpu python bench.py --trace-smoke
+
+# Fault-injection differential gate (docs/RESILIENCE.md): seeded fault
+# schedules against serve load, K-worker streamed ingest, and the
+# 8-way mesh join.  Recoverable faults must yield bitwise-equal
+# results with zero warm recompiles; unrecoverable ones must surface
+# typed (dispatcher crashes fail every pending future with
+# ServerCrashed in <1s); every case runs under a watchdog so a hang is
+# a failure; the DISARMED injection hooks must cost <=1% of a served
+# request.  Writes CHAOS_r09.json; the unit-level chaos suite
+# (tests/test_chaos.py) runs first.
+chaos:
+	JAX_PLATFORMS=cpu timeout -k 10 600 python -m pytest tests/test_chaos.py -q
+	timeout -k 10 600 python chaos.py
 
 dryrun:
 	python __graft_entry__.py

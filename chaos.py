@@ -1,0 +1,529 @@
+#!/usr/bin/env python
+"""`make chaos`: seeded fault-injection differential gate (ISSUE 8, r09).
+
+Runs seeded fault schedules against the three workload shapes —
+serve load, K-worker streamed ingest, and the 8-way mesh join — and
+holds the recovery ladder to the differential contract:
+
+* when recovery is possible (transient device faults within the retry
+  budget, breaker fallback, crashed ingest workers) the results must be
+  BITWISE-EQUAL to the fault-free oracle, with zero warm recompiles on
+  the retry path (``RecompileWatch.assert_zero``);
+* when it is not (fatal faults, dispatcher death, I/O errors) the
+  failure must surface as its TYPED error — ``ServerCrashed`` for every
+  pending future within 1s of a dispatcher crash, row-numbered
+  ``DataSourceError`` for source I/O — never a hang or a silent wrong
+  answer.  Every case runs under a watchdog timeout, so a hang IS a
+  failure, not a stuck CI job.
+* the disarmed injection hooks must cost <= 1% of a served request
+  (measured here, recorded in the artifact — the same discipline as
+  `make trace-smoke`'s disabled-hook gate).
+
+Contract (matches the benches): diagnostics go to stderr, stdout
+carries ONE compact JSON line; CHAOS_r09.json records the full
+evidence — per-case injection counts (``FaultPlan.snapshot``), recovery
+outcomes, serve retry/degrade metrics, telemetry counters
+(``ingest.worker_recovered``), and the overhead measurement.  Exits
+nonzero when any case fails its contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+# hermetic 8-device CPU mesh, same recipe as tests/conftest.py
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["CSVPLUS_TPU_HERMETIC"] = "1"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+#: Watchdog bound per chaos case: a case that cannot finish inside this
+#: is a hang, which is exactly what the resilience layer must prevent.
+CASE_TIMEOUT_S = float(os.environ.get("CSVPLUS_CHAOS_CASE_TIMEOUT", 120))
+ARTIFACT = os.path.join(REPO, "CHAOS_r09.json")
+#: Disarmed-hook budget: injection sites on the serve path may cost at
+#: most this fraction of one served request.
+OVERHEAD_BUDGET_PCT = 1.0
+
+
+def _with_timeout(name: str, fn):
+    """Run one chaos case under the watchdog.  Returns the case record;
+    a timeout or an escape is a recorded failure, never a hang of the
+    gate itself."""
+    box: dict = {}
+
+    def run():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # recorded + failed, gate must finish
+            box["error"] = f"{type(e).__name__}: {e}"
+
+    t0 = time.perf_counter()
+    th = threading.Thread(target=run, name=f"chaos-{name}", daemon=True)
+    th.start()
+    th.join(CASE_TIMEOUT_S)
+    elapsed = time.perf_counter() - t0
+    if th.is_alive():
+        rec = {"ok": False, "error": f"timeout after {CASE_TIMEOUT_S}s (hang)"}
+    elif "error" in box:
+        rec = {"ok": False, "error": box["error"]}
+    else:
+        rec = dict(box["result"])
+        rec.setdefault("ok", True)
+    rec["seconds"] = round(elapsed, 3)
+    status = "ok" if rec["ok"] else f"FAIL ({rec.get('error', 'contract')})"
+    sys.stderr.write(f"chaos[{name}]: {status} in {elapsed:.2f}s\n")
+    return rec
+
+
+def _build_index(n=20_000):
+    import numpy as np
+
+    import csvplus_tpu as cp
+    from csvplus_tpu.columnar.table import DeviceTable
+
+    ids = np.arange(n, dtype=np.int64) * 7 % (n * 3)
+    t = DeviceTable.from_pylists(
+        {
+            "id": np.char.add("c", ids.astype(np.str_)).tolist(),
+            "v": np.arange(n).astype(np.str_).tolist(),
+        },
+        device="cpu",
+    )
+    return cp.take(t).index_on("id").sync(), ids
+
+
+def _probes(ids, n, seed=0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    ps = [f"c{int(v)}" for v in rng.choice(ids, n)]
+    ps[::17] = ["nope"] * len(ps[::17])
+    return ps
+
+
+# ---- serve load under faults ---------------------------------------------
+
+
+def case_serve_retry(idx, ids):
+    """Transient device faults on the coalesced lookup: absorbed by
+    retries, bitwise-equal results, zero warm recompiles."""
+    from csvplus_tpu.obs.recompile import RecompileWatch
+    from csvplus_tpu.resilience import faults
+    from csvplus_tpu.resilience.faults import FaultPlan
+    from csvplus_tpu.resilience.retry import RetryPolicy
+    from csvplus_tpu.serve import LookupServer
+
+    probes = _probes(ids, 600, seed=1)
+    serial = [idx.find(p).to_rows() for p in probes]
+    with LookupServer(idx) as srv:
+        srv.retry_policy = RetryPolicy(max_attempts=3, base_s=1e-4, cap_s=1e-3)
+        for f in [srv.submit(p) for p in probes[:50]]:  # warm off-watch
+            f.result(timeout=30.0)
+        with RecompileWatch() as w:
+            with faults.active(
+                FaultPlan(
+                    [{"site": "serve:bounds", "at": [0, 2, 5], "error": "device"}],
+                    seed=9,
+                )
+            ) as plan:
+                futs = [srv.submit(p) for p in probes]
+                got = [f.result(timeout=30.0) for f in futs]
+        w.assert_zero("chaos serve retries")
+        snap = srv.snapshot()
+    return {
+        "ok": got == serial and snap["retried"] >= 1 and snap["failed"] == 0,
+        "bitwise_equal": got == serial,
+        "recompile_observable": w.observable(),
+        "injections": plan.snapshot(),
+        "metrics": {k: snap[k] for k in ("retried", "degraded", "failed")},
+    }
+
+
+def case_serve_degrade(idx, ids):
+    """Retries exhaust under a 100% device-fault schedule: the breaker
+    trips onto the host oracle (bitwise parity), then half-open probing
+    recovers the device path once faults stop."""
+    from csvplus_tpu.resilience import faults
+    from csvplus_tpu.resilience.degrade import CircuitBreaker
+    from csvplus_tpu.resilience.faults import FaultPlan
+    from csvplus_tpu.resilience.retry import RetryPolicy
+    from csvplus_tpu.serve import LookupServer
+
+    probes = _probes(ids, 300, seed=2)
+    serial = [idx.find(p).to_rows() for p in probes]
+    with LookupServer(idx) as srv:
+        srv.retry_policy = RetryPolicy(max_attempts=2, base_s=1e-4, cap_s=1e-3)
+        srv.breaker = CircuitBreaker(threshold=2, cooldown_s=0.05)
+        with faults.active(
+            FaultPlan([{"site": "serve:bounds", "every": 1, "error": "device"}])
+        ) as plan:
+            futs = [srv.submit(p) for p in probes]
+            got = [f.result(timeout=30.0) for f in futs]
+        snap = srv.snapshot()
+        opened = srv.breaker.state == "open"
+        time.sleep(0.06)  # cooldown: next route is the half-open probe
+        again = [srv.submit(p) for p in probes[:20]]
+        recovered = [f.result(timeout=30.0) for f in again] == serial[:20]
+        closed = srv.breaker.state == "closed"
+    return {
+        "ok": got == serial
+        and snap["failed"] == 0
+        and snap["degraded"] >= len(probes)
+        and opened
+        and recovered
+        and closed,
+        "bitwise_equal_degraded": got == serial,
+        "breaker_opened": opened,
+        "breaker_recovered": closed,
+        "injections": plan.snapshot(),
+        "metrics": {k: snap[k] for k in ("retried", "degraded", "failed")},
+    }
+
+
+def case_dispatcher_crash(idx, ids):
+    """A fatal fault in the dispatcher: every pending future fails with
+    typed ServerCrashed in under a second; post-mortem submits fail
+    fast at admission."""
+    from csvplus_tpu.resilience import faults
+    from csvplus_tpu.resilience.faults import FaultPlan
+    from csvplus_tpu.resilience.retry import ServerCrashed
+    from csvplus_tpu.serve import LookupServer
+
+    srv = LookupServer(idx, tick_us=20_000)  # hold the doomed batch open
+    srv.start()
+    try:
+        with faults.active(
+            FaultPlan([{"site": "serve:dispatch", "at": [0], "error": "fatal"}])
+        ) as plan:
+            futs = []
+            for v in ids[:16]:
+                try:
+                    futs.append(srv.submit(f"c{int(v)}"))
+                except ServerCrashed:
+                    break
+            t0 = time.perf_counter()
+            typed = 0
+            for f in futs:
+                try:
+                    f.result(timeout=1.0)
+                except ServerCrashed:
+                    typed += 1
+                except BaseException:
+                    pass
+            unblock_s = time.perf_counter() - t0
+        try:
+            srv.submit(f"c{int(ids[0])}")
+            post_typed = False
+        except ServerCrashed:
+            post_typed = True
+        return {
+            "ok": bool(futs)
+            and typed == len(futs)
+            and unblock_s < 1.0
+            and post_typed,
+            "pending_futures": len(futs),
+            "typed_failures": typed,
+            "unblock_seconds": round(unblock_s, 4),
+            "post_crash_submit_typed": post_typed,
+            "injections": plan.snapshot(),
+        }
+    finally:
+        srv.stop()
+
+
+# ---- K-worker streamed ingest under faults -------------------------------
+
+
+def _chaos_csv(root, rows=2000):
+    path = os.path.join(root, "chaos_ingest.csv")
+    with open(path, "w") as f:
+        f.write("k,v\n")
+        for i in range(rows):
+            f.write(f"k{i},v{i * 3}\n")
+    return path
+
+
+def _stream_fold(path, workers, chunk_bytes=512):
+    import numpy as np
+
+    from csvplus_tpu import DataSourceError, from_file
+    from csvplus_tpu.native import scanner as native
+
+    out = []
+    try:
+        for names, encoded, n in native.stream_encoded_chunks(
+            from_file(path), path, chunk_bytes=chunk_bytes, workers=workers
+        ):
+            chunk = {}
+            for c, enc in encoded.items():
+                if len(enc) == 3 and enc[0] == "int":
+                    chunk[c] = ("typed", enc[1], enc[2].tolist())
+                else:
+                    chunk[c] = (
+                        "dict",
+                        [bytes(x) for x in enc[0].tolist()],
+                        np.asarray(enc[1]).tolist(),
+                    )
+            out.append((tuple(names), chunk, n))
+    except DataSourceError as e:
+        return ("exc", type(e).__name__, str(e), out)
+    return ("ok", out)
+
+
+def case_ingest_crash_recovery(tmp_root):
+    """Crashed scan+encode workers re-execute their chunks: the emitted
+    stream is bitwise-identical to the fault-free run for every K."""
+    from csvplus_tpu.resilience import faults
+    from csvplus_tpu.resilience.faults import FaultPlan
+
+    path = _chaos_csv(tmp_root)
+    oracle = _stream_fold(path, workers=1)
+    per_k = {}
+    ok = oracle[0] == "ok" and len(oracle[1]) > 4
+    for k in (1, 2, 4):
+        with faults.active(
+            FaultPlan(
+                [{"site": "ingest:worker", "at": [1, 3, 4, 9], "error": "crash"}],
+                seed=5,
+            )
+        ) as plan:
+            got = _stream_fold(path, workers=k)
+        snap = plan.snapshot()
+        per_k[str(k)] = {
+            "bitwise_equal": got == oracle,
+            "injections": snap,
+        }
+        ok = ok and got == oracle and snap["fired"].get("ingest:worker", 0) >= 1
+    return {"ok": ok, "chunks": len(oracle[1]), "per_workers": per_k}
+
+
+def case_ingest_read_fault_typed(tmp_root):
+    """Unrecoverable read I/O faults surface as row-numbered
+    DataSourceError with a K-independent outcome (emitted prefix +
+    message), never a partial silent stream."""
+    from csvplus_tpu.resilience import faults
+    from csvplus_tpu.resilience.faults import FaultPlan
+
+    path = _chaos_csv(tmp_root)
+    outcomes = {}
+    for k in (1, 2):
+        with faults.active(
+            FaultPlan([{"site": "ingest:read", "at": [2], "error": "io"}])
+        ) as plan:
+            outcomes[k] = _stream_fold(path, workers=k)
+        snap = plan.snapshot()
+    typed = outcomes[1][0] == "exc" and outcomes[1][1] == "DataSourceError"
+    return {
+        "ok": typed and outcomes[1] == outcomes[2],
+        "typed": typed,
+        "k_independent": outcomes[1] == outcomes[2],
+        "error": outcomes[1][2] if typed else None,
+        "injections": snap,
+    }
+
+
+# ---- mesh join under faults ----------------------------------------------
+
+
+def case_mesh_join_under_ingest_faults(tmp_root):
+    """The 8-way sharded mesh join with crashing ingest workers under
+    its streamed build: recovered ingest keeps the join bitwise-equal
+    to the fault-free run."""
+    import csvplus_tpu.models.workloads as W
+    from csvplus_tpu import Take, from_file
+    from csvplus_tpu.resilience import faults
+    from csvplus_tpu.resilience.faults import FaultPlan
+
+    cust_path = os.path.join(tmp_root, "cust.csv")
+    with open(cust_path, "w") as f:
+        f.write("id,name\n")
+        for i in range(120):
+            f.write(f"u{i},name{i % 12}\n")
+    orders_path = os.path.join(tmp_root, "orders.csv")
+    with open(orders_path, "w") as f:
+        f.write("oid,cust_id,amount\n")
+        for i in range(4000):
+            f.write(f"o{i},u{(i * 13) % 120},{i % 97}\n")
+
+    def run_join():
+        cust = Take(from_file(cust_path)).unique_index_on("id")
+        cust.on_device("cpu")
+        return W.sharded_join(from_file(orders_path), cust, shards=8).to_rows()
+
+    # shrink the stream chunk so the ~60KB orders file really flows
+    # through the staged multi-chunk ingest (default chunks are 64MB —
+    # the whole file would be one establishment chunk with no worker
+    # executions to crash)
+    prev_env = {
+        k: os.environ.get(k)
+        for k in ("CSVPLUS_STREAM_CHUNK_BYTES", "CSVPLUS_STREAM_MIN_BYTES")
+    }
+    os.environ["CSVPLUS_STREAM_CHUNK_BYTES"] = "4096"
+    os.environ["CSVPLUS_STREAM_MIN_BYTES"] = "1"  # tier gate: stream always
+    try:
+        oracle = run_join()
+        with faults.active(
+            FaultPlan(
+                [{"site": "ingest:worker", "at": [1, 2], "error": "crash"}],
+                seed=11,
+            )
+        ) as plan:
+            got = run_join()
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    snap = plan.snapshot()
+    return {
+        "ok": got == oracle
+        and len(oracle) == 4000
+        and snap["fired"].get("ingest:worker", 0) >= 1,
+        "bitwise_equal": got == oracle,
+        "rows": len(oracle),
+        "injections": snap,
+    }
+
+
+# ---- disarmed-hook overhead gate -----------------------------------------
+
+
+def case_disarmed_overhead(idx, ids):
+    """The disarmed inject() fast path, priced against one served
+    request (same discipline as `make trace-smoke`): sites on the serve
+    path must cost <= OVERHEAD_BUDGET_PCT of a request."""
+    from csvplus_tpu.resilience import faults
+    from csvplus_tpu.serve import LookupServer
+
+    assert faults.current() is None
+    reps = 200_000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        faults.inject("serve:bounds")
+    per_call_s = (time.perf_counter() - t0) / reps
+
+    probes = _probes(ids, 2000, seed=3)
+    with LookupServer(idx) as srv:
+        for f in [srv.submit(p) for p in probes[:50]]:  # warm
+            f.result(timeout=30.0)
+        t0 = time.perf_counter()
+        for f in [srv.submit(p) for p in probes]:
+            f.result(timeout=30.0)
+        per_request_s = (time.perf_counter() - t0) / len(probes)
+
+    # two sites sit on a served lookup's path: serve:dispatch (amortized
+    # across the batch, charged per-request here to stay conservative)
+    # and serve:bounds
+    sites_per_request = 2
+    pct = 100.0 * sites_per_request * per_call_s / per_request_s
+    return {
+        "ok": pct <= OVERHEAD_BUDGET_PCT,
+        "per_call_ns": round(per_call_s * 1e9, 2),
+        "per_request_us": round(per_request_s * 1e6, 2),
+        "sites_per_request": sites_per_request,
+        "overhead_pct": round(pct, 4),
+        "budget_pct": OVERHEAD_BUDGET_PCT,
+    }
+
+
+def main() -> int:
+    import tempfile
+
+    import jax
+
+    from csvplus_tpu.obs.memory import host_header
+    from csvplus_tpu.utils.observe import telemetry
+
+    sys.stderr.write(
+        f"chaos: backend={jax.default_backend()}"
+        f" devices={jax.device_count()}\n"
+    )
+    idx, ids = _build_index()
+    cases: dict = {}
+    telemetry.enabled = True
+    telemetry.reset()
+    try:
+        with tempfile.TemporaryDirectory(prefix="csvplus-chaos-") as tmp_root:
+            cases["serve_retry"] = _with_timeout(
+                "serve_retry", lambda: case_serve_retry(idx, ids)
+            )
+            cases["serve_degrade"] = _with_timeout(
+                "serve_degrade", lambda: case_serve_degrade(idx, ids)
+            )
+            cases["dispatcher_crash"] = _with_timeout(
+                "dispatcher_crash", lambda: case_dispatcher_crash(idx, ids)
+            )
+            cases["ingest_crash_recovery"] = _with_timeout(
+                "ingest_crash_recovery",
+                lambda: case_ingest_crash_recovery(tmp_root),
+            )
+            cases["ingest_read_fault_typed"] = _with_timeout(
+                "ingest_read_fault_typed",
+                lambda: case_ingest_read_fault_typed(tmp_root),
+            )
+            cases["mesh_join_under_ingest_faults"] = _with_timeout(
+                "mesh_join", lambda: case_mesh_join_under_ingest_faults(tmp_root)
+            )
+            cases["disarmed_overhead"] = _with_timeout(
+                "disarmed_overhead", lambda: case_disarmed_overhead(idx, ids)
+            )
+    finally:
+        telemetry_json = telemetry.to_json()
+        telemetry.enabled = False
+
+    failed = sorted(k for k, v in cases.items() if not v.get("ok"))
+    record = {
+        "metric": "chaos_cases_passed",
+        "value": len(cases) - len(failed),
+        "cases_total": len(cases),
+        "failed": failed,
+        "case_timeout_s": CASE_TIMEOUT_S,
+        "backend": jax.default_backend(),
+        **host_header(),
+        "cases": cases,
+        "telemetry": telemetry_json,
+    }
+    try:
+        record["commit"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, cwd=REPO, timeout=10,
+        ).stdout.strip() or None
+    except Exception:
+        pass
+
+    with open(ARTIFACT, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    sys.stderr.write(f"chaos: artifact written to {ARTIFACT}\n")
+
+    compact = {
+        k: record[k]
+        for k in ("metric", "value", "cases_total", "failed", "backend")
+    }
+    compact["overhead_pct"] = cases.get("disarmed_overhead", {}).get(
+        "overhead_pct"
+    )
+    print(json.dumps(compact), flush=True)
+    if failed:
+        sys.stderr.write(f"chaos FAIL: {', '.join(failed)}\n")
+        return 1
+    sys.stderr.write(f"chaos ok: {len(cases)}/{len(cases)} cases\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,6 @@
 """Repo-specific AST lint: rules generic linters cannot know.
 
-Five rule classes have bitten this codebase (or its measured history)
+Six rule classes have bitten this codebase (or its measured history)
 and are mechanically checkable from the AST:
 
 * **CTYPES001** — the native scanner boundary.  The C ABI's ``c_char``
@@ -42,10 +42,18 @@ and are mechanically checkable from the AST:
   argument) — except under a module-level ``threading.Lock``/``RLock``
   ``with`` block (double-checked pool/library init) or into
   ``threading.local()`` storage.
+* **FAULT001** — the silent-swallow boundary (ISSUE 8).  The reference
+  error contract says every failure surfaces typed and row-annotated
+  (csvplus.go:1229-1238), but a broad ``except``/``except Exception``/
+  ``except BaseException`` handler whose body is ONLY ``pass``/
+  ``continue`` silently discards whatever went wrong.  Handlers must
+  re-raise, wrap via ``map_error``, or record the failure to
+  metrics/telemetry/stderr; narrowly-typed best-effort catches
+  (``except (OSError, AttributeError):``) remain legal.
 
-Each of TRACE001/EAGER001/THREAD001 carries an explicit allowance list
-below (``*_ALLOWED``) that STARTS EMPTY and must stay empty for the
-current tree; additions need review.
+Each of TRACE001/EAGER001/THREAD001/FAULT001 carries an explicit
+allowance list below (``*_ALLOWED``) that STARTS EMPTY and must stay
+empty for the current tree; additions need review.
 
 Suppression: a ``# analysis: allow[CODE]`` comment on the flagged line
 or on the enclosing ``def`` line.
@@ -67,7 +75,7 @@ __all__ = ["LintFinding", "lint_source", "lint_file", "lint_paths"]
 
 @dataclass(frozen=True)
 class LintFinding:
-    code: str  # "CTYPES001" | "JIT001" | "TRACE001" | "EAGER001" | "THREAD001"
+    code: str  # "CTYPES001" | "JIT001" | "TRACE001" | "EAGER001" | "THREAD001" | "FAULT001"
     path: str
     line: int
     message: str
@@ -308,6 +316,7 @@ class _JitVisitor(ast.NodeVisitor):
 TRACE001_ALLOWED: frozenset = frozenset()
 EAGER001_ALLOWED: frozenset = frozenset()
 THREAD001_ALLOWED: frozenset = frozenset()
+FAULT001_ALLOWED: frozenset = frozenset()
 
 # modules whose per-row loops sit on the measured hot path (r06)
 _EAGER_HOT_DIRS = ("ops",)
@@ -342,6 +351,18 @@ _WORKER_ENTRY_NAMES = (
     "drain",
     "register_kernel",
     "_sample_loop",
+    # csvplus_tpu/resilience entry points (ISSUE 8): the fault plan's
+    # hit-counter mutator (armed chaos runs hit it from every worker,
+    # dispatcher, and submitter thread), the circuit breaker's
+    # route/outcome mutators, and the new serving-metrics counters
+    # (retry / degrade / callback-error accounting).
+    "fire",
+    "route",
+    "on_success",
+    "on_failure",
+    "on_retry",
+    "on_degraded",
+    "on_callback_error",
 )
 
 _EAGER_TRANSFORM_OPS = frozenset(
@@ -911,6 +932,68 @@ def _thread_findings(tree: ast.Module, path: str) -> List[LintFinding]:
     return findings
 
 
+_BROAD_EXCEPT_NAMES = frozenset({"Exception", "BaseException"})
+
+
+def _enclosing_function(tree: ast.Module, line: int) -> Optional[ast.AST]:
+    """The innermost function whose span contains *line*, or None."""
+    best: Optional[ast.AST] = None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end and (
+                best is None or node.lineno > best.lineno
+            ):
+                best = node
+    return best
+
+
+def _fault_findings(tree: ast.Module, path: str) -> List[LintFinding]:
+    """FAULT001: a broad exception handler — bare ``except``,
+    ``except Exception``, ``except BaseException`` (alone or inside a
+    tuple) — whose body is nothing but ``pass``/``continue``.  The
+    failure is silently swallowed; the reference contract (typed,
+    row-annotated, surfaced) forbids that.  Handlers that re-raise,
+    wrap, return, log, or count are untouched, as are narrowly-typed
+    best-effort catches."""
+
+    def is_broad(h: ast.ExceptHandler) -> bool:
+        t = h.type
+        if t is None:
+            return True
+        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+        for n in elts:
+            if isinstance(n, ast.Name) and n.id in _BROAD_EXCEPT_NAMES:
+                return True
+            if isinstance(n, ast.Attribute) and n.attr in _BROAD_EXCEPT_NAMES:
+                return True
+        return False
+
+    findings: List[LintFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not is_broad(node):
+            continue
+        if not all(isinstance(s, (ast.Pass, ast.Continue)) for s in node.body):
+            continue
+        func = _enclosing_function(tree, node.lineno)
+        if _allow_key(path, func) in FAULT001_ALLOWED:
+            continue
+        findings.append(
+            LintFinding(
+                "FAULT001",
+                path,
+                node.lineno,
+                "broad except handler silently swallows the error — "
+                "re-raise, wrap via map_error, or record it to "
+                "metrics/telemetry (the reference contract surfaces "
+                "every failure typed and row-annotated)",
+            )
+        )
+    return findings
+
+
 def _suppressed(finding: LintFinding, lines: List[str], tree: ast.Module) -> bool:
     marker = f"analysis: allow[{finding.code}]"
 
@@ -949,6 +1032,7 @@ def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
         e.visit(tree)
         findings.extend(e.findings)
     findings.extend(_thread_findings(tree, path))
+    findings.extend(_fault_findings(tree, path))
     lines = source.splitlines()
     findings = [f for f in findings if not _suppressed(f, lines, tree)]
     findings.sort(key=lambda f: (f.path, f.line, f.code))

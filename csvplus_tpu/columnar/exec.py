@@ -205,11 +205,16 @@ def execute_plan_view(root: P.PlanNode, preverified: bool = False) -> "_View":
         )
 
     from ..obs.span import tracer
+    from ..resilience import faults
     from ..utils.observe import telemetry
 
     # grouping span: in a trace, the per-node stages nest under one
     # plan:execute region instead of sitting flat beside unrelated work
     with tracer.span("plan:execute", nodes=len(stages) - 1):
+        # chaos site: a transient raise here fails the whole execution
+        # before any stage runs; the serving tier's retry re-executes
+        # the cached executable (zero recompiles)
+        faults.inject("exec:device")
         for node in stages[1:]:
             with telemetry.stage(type(node).__name__, int(view.sel.shape[0])) as _t:
                 view = _exec_stage(view, node)

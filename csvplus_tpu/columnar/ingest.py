@@ -794,8 +794,10 @@ def _trim_host_staging() -> None:
         import ctypes
 
         ctypes.CDLL("libc.so.6").malloc_trim(0)
-    except Exception:
-        pass
+    except (OSError, AttributeError):
+        # no glibc (CDLL raises OSError) or a libc without malloc_trim
+        # (AttributeError): best-effort memory hygiene, nothing to report
+        return
 
 
 def _values_concat(chunks):

@@ -23,7 +23,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..csvio import ERR_BARE_QUOTE, ERR_FIELD_COUNT, ERR_QUOTE
-from ..errors import DataSourceError
+from ..errors import DataSourceError, map_error
+from ..resilience import faults
 from ..utils.env import env_int as _env_int
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -1095,6 +1096,7 @@ def _iter_parity_chunks(reader, f, chunk_bytes: int):
     pend_quote = False
     eof = False
     while not eof:
+        faults.inject("ingest:read")  # chaos site: I/O error mid-file
         raw = f.read(chunk_bytes)
         if not raw:
             eof = True
@@ -1310,6 +1312,7 @@ def _scan_encode_chunk(ctx, data):
     workers run it concurrently and the reassembler's file-order merge
     is the only serialization point.  The native scan/pack/encode
     helpers release the GIL, so the workers genuinely overlap."""
+    faults.inject("ingest:worker")  # chaos site: one worker crashes
     res = _ChunkResult()
     res.worker = threading.current_thread().name
     t0 = time.perf_counter()
@@ -1391,6 +1394,35 @@ def _scan_encode_chunk(ctx, data):
     _encode_scanned(ctx, res, data, scratch, starts, lens, counts, 0, 1)
     res.t_encode = time.perf_counter() - t0 - res.t_scan
     return res
+
+
+#: Bounded re-executions of one chunk after transient worker crashes.
+_WORKER_RETRIES = 3
+
+
+def _run_chunk(ctx, data):
+    """Run one staged worker unit, re-executing the chunk after a
+    transient worker crash (bounded by :data:`_WORKER_RETRIES`).
+
+    Sound by construction: :func:`_scan_encode_chunk` is pure over the
+    immutable ``ctx`` snapshot and the chunk bytes, so re-execution is
+    idempotent — the reassembler (and therefore the emitted stream)
+    cannot observe that a crash happened.  Non-transient failures
+    re-raise untouched; recoveries land on the telemetry counter
+    ``ingest.worker_recovered``."""
+    from ..resilience.retry import TRANSIENT, classify
+
+    attempt = 0
+    while True:
+        try:
+            return _scan_encode_chunk(ctx, data)
+        except Exception as err:
+            if classify(err) != TRANSIENT or attempt >= _WORKER_RETRIES:
+                raise
+            attempt += 1
+            from ..utils.observe import telemetry
+
+            telemetry.count("ingest.worker_recovered")
 
 
 def stream_encoded_chunks(
@@ -1495,7 +1527,13 @@ def stream_encoded_chunks(
         w = stats["per_worker"]
         w[res.worker] = w.get(res.worker, 0.0) + res.t_scan + res.t_encode
 
-    with open(path, "rb") as f:
+    try:
+        f = open(path, "rb")
+    except OSError as e:
+        # same typed shape as Reader._open: the source failed before
+        # row 1 (nonexistent file, permission denied, directory, ...)
+        raise DataSourceError(1, f"open: {e.strerror or e}") from e
+    with f:
         chunks_iter = _iter_parity_chunks(reader, f, chunk_bytes)
         ctx = None
 
@@ -1503,7 +1541,16 @@ def stream_encoded_chunks(
         # Header resolution, field-count locking, and typed-prefix
         # derivation all happen here, exactly as the whole-file tiers do;
         # afterwards the context is immutable to workers. ----
-        for data in chunks_iter:
+        while True:
+            try:
+                data = next(chunks_iter, None)
+            except OSError as e:
+                # a read failure before the first encoded chunk: typed
+                # and numbered at the next unread record, per the
+                # reference error contract
+                raise map_error(e, next_record) from e
+            if data is None:
+                break
             t0 = _pc()
             if b"\x00" in data:
                 raise StreamFallback("NUL in chunk")
@@ -1611,6 +1658,7 @@ def stream_encoded_chunks(
 
         # ---- staged phase: readahead -> K workers -> ordered emit ----
         cut_error = None
+        read_error = None
         if k_workers == 1:
             # degenerate case: the same worker function, driven inline
             while True:
@@ -1620,10 +1668,13 @@ def stream_encoded_chunks(
                 except StreamFallback as e:
                     cut_error = e
                     data = None
+                except OSError as e:
+                    read_error = e
+                    data = None
                 stats["cut"] += _pc() - t0
                 if data is None:
                     break
-                yield emit(_scan_encode_chunk(ctx, data))
+                yield emit(_run_chunk(ctx, data))
         else:
             from collections import deque
             from concurrent.futures import ThreadPoolExecutor
@@ -1648,19 +1699,47 @@ def stream_encoded_chunks(
                             # the serial loop ordered them
                             cut_error = e
                             data = None
+                        except OSError as e:
+                            # a failed readahead: already-cut chunks
+                            # still emit first (same drain order as the
+                            # serial loop) before the error surfaces
+                            read_error = e
+                            data = None
                         stats["cut"] += _pc() - t0
                         if data is None:
                             exhausted = True
                             break
-                        pending.append(pool.submit(_scan_encode_chunk, ctx, data))
+                        pending.append(
+                            (pool.submit(_scan_encode_chunk, ctx, data), data)
+                        )
                     if not pending:
                         break
                     t0 = _pc()
-                    res = pending.popleft().result()
+                    fut, chunk_data = pending.popleft()
+                    try:
+                        res = fut.result()
+                    except Exception as err:
+                        from ..resilience.retry import TRANSIENT, classify
+
+                        if classify(err) != TRANSIENT:
+                            raise
+                        # a crashed worker: re-execute its chunk inline
+                        # on the reassembler (pure + immutable ctx, so
+                        # idempotent; it slots into the same head-of-
+                        # line position, keeping K unobservable)
+                        from ..utils.observe import telemetry
+
+                        telemetry.count("ingest.worker_recovered")
+                        res = _run_chunk(ctx, chunk_data)
                     stats["stall"] += _pc() - t0
                     yield emit(res)
             finally:
                 pool.shutdown(wait=False, cancel_futures=True)
+        if read_error is not None:
+            # every record already cut has emitted, so next_record is
+            # the absolute 1-based ordinal the failed read would have
+            # produced next — typed, reference numbering
+            raise map_error(read_error, next_record) from read_error
         if cut_error is not None:
             raise cut_error
 
@@ -1714,8 +1793,11 @@ def _scan_for_reader(reader, path: str):
     if reader._comment is not None and len(reader._comment.encode("utf-8")) != 1:
         return None
 
-    with open(path, "rb") as f:
-        data = f.read()
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise DataSourceError(1, f"open: {e.strerror or e}") from e
 
     starts, lens, counts, scratch = scan_bytes_parallel(
         data,

@@ -1,0 +1,50 @@
+"""Fault injection, retry, and graceful degradation (ISSUE 8).
+
+Three small pieces, threaded through serve, ingest, and the device
+exec path:
+
+* :mod:`.faults` — seeded deterministic fault injection at existing
+  span/stage boundaries (``CSVPLUS_FAULTS`` env or in-process plans);
+  one global None-check per site when disarmed.
+* :mod:`.retry` — the transient/data/fatal taxonomy and the one
+  deadline-aware bounded-retry primitive (decorrelated jitter, spans,
+  zero warm recompiles).
+* :mod:`.degrade` — the circuit breaker and the bitwise-identical
+  host-fallback lookup oracle the serving tier degrades onto.
+
+The chaos differential gate (``make chaos``, tests/test_chaos.py)
+drives seeded fault schedules against serve load, K-worker ingest, and
+the plan path, asserting bitwise parity with the fault-free run when
+recovery succeeds and typed surfaced errors when it cannot.  See
+docs/RESILIENCE.md.
+"""
+
+from .degrade import CircuitBreaker, HostLookupOracle
+from .faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedDeviceError,
+    InjectedFatalError,
+    InjectedIOError,
+    InjectedWorkerCrash,
+    inject,
+    plan_from_env,
+)
+from .retry import RetryPolicy, ServerCrashed, call_with_retry, classify
+
+__all__ = [
+    "CircuitBreaker",
+    "FaultPlan",
+    "FaultSpec",
+    "HostLookupOracle",
+    "InjectedDeviceError",
+    "InjectedFatalError",
+    "InjectedIOError",
+    "InjectedWorkerCrash",
+    "RetryPolicy",
+    "ServerCrashed",
+    "call_with_retry",
+    "classify",
+    "inject",
+    "plan_from_env",
+]

@@ -25,9 +25,13 @@ building blocks into a service:
   p50/p99 latency reservoir, exportable as a JSON snapshot and mirrored
   into :mod:`csvplus_tpu.utils.observe` stage conventions.
 
-See docs/SERVING.md for the architecture and env knobs.
+Failure handling (retry, circuit-breaker degradation onto the host
+oracle, typed :class:`ServerCrashed` dispatcher hardening) comes from
+:mod:`csvplus_tpu.resilience`; see docs/SERVING.md for the
+architecture and env knobs, docs/RESILIENCE.md for the failure model.
 """
 
+from ..resilience.retry import ServerCrashed
 from .admit import AdmissionController, DeadlineExceeded, ServerOverloaded
 from .coalesce import LookupServer
 from .metrics import BatchHistogram, LatencyReservoir, ServingMetrics
@@ -41,6 +45,7 @@ __all__ = [
     "LookupServer",
     "PlanCache",
     "PlanRejected",
+    "ServerCrashed",
     "ServerOverloaded",
     "ServingMetrics",
     "plan_cache_key",

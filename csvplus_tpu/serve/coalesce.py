@@ -32,15 +32,33 @@ flags any unguarded mutation of server state, with zero allowances.
 Caller-side futures are safe by construction: a request is completed
 only after it is popped from the queue, and completion sets a per-
 request event that the submitting thread waits on.
+
+Failure model (ISSUE 8, docs/RESILIENCE.md): transient device failures
+on the coalesced lookup get bounded deadline-aware retries; retries
+exhausting feeds a circuit breaker that degrades the server onto a
+bitwise-identical host-fallback oracle (half-open probes recover it);
+and ANY dispatcher death fails every pending and future request fast
+with a typed :class:`~csvplus_tpu.resilience.retry.ServerCrashed`
+instead of hanging clients.
 """
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..obs.span import tracer
+from ..resilience import faults
+from ..resilience.degrade import CircuitBreaker, HostLookupOracle
+from ..resilience.retry import (
+    TRANSIENT,
+    RetryPolicy,
+    ServerCrashed,
+    call_with_retry,
+    classify,
+)
 from ..row import Row
 from ..utils.env import env_int
 from .admit import AdmissionController, DeadlineExceeded
@@ -62,9 +80,11 @@ class ServeFuture:
     """
 
     __slots__ = ("probe", "plan", "deadline_s", "callback", "t_submit",
-                 "t_dispatch", "trace_ctx", "value", "error", "_event")
+                 "t_dispatch", "trace_ctx", "value", "error", "_event",
+                 "_done")
 
     def __init__(self, probe, plan, deadline_s, callback):
+        self._done = False
         self.probe = probe
         self.plan = plan
         self.deadline_s = deadline_s
@@ -128,6 +148,13 @@ class LookupServer:
         self._pending: List[ServeFuture] = []
         self._open = False
         self._thread: Optional[threading.Thread] = None
+        # resilience: retry policy + breaker for the coalesced lookup
+        # path, the host oracle the breaker degrades onto, and the
+        # crash record that fails post-mortem submits fast
+        self.retry_policy = RetryPolicy()
+        self.breaker = CircuitBreaker()
+        self._oracle = HostLookupOracle(self._impl)
+        self._crashed: Optional[ServerCrashed] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -201,6 +228,10 @@ class LookupServer:
 
     def _enqueue(self, req: ServeFuture) -> ServeFuture:
         with self._cv:
+            if self._crashed is not None:
+                # the dispatcher is dead: fail fast and typed, never
+                # queue against a thread that will not drain
+                raise self._crashed
             if not self._open:
                 raise RuntimeError("LookupServer is not running (call start())")
             try:
@@ -236,7 +267,14 @@ class LookupServer:
                     return
             self.metrics.on_tick(depth_after + len(batch))
             if batch:
-                self._run_batch(batch)
+                try:
+                    self._run_batch(batch)
+                except BaseException as err:
+                    # dispatcher hardening: an escape here used to
+                    # leave every pending future hanging forever —
+                    # instead fail everything typed and fast
+                    self._on_dispatcher_crash(err, batch)
+                    return
 
     def _run_batch(self, batch: List[ServeFuture]) -> None:
         """Execute one drained batch OUTSIDE the queue lock: deadline
@@ -244,6 +282,7 @@ class LookupServer:
         then scatter.  Every request in *batch* has left the queue — the
         dispatcher owns it exclusively until completion.  Metrics land
         in one lock round at the end (``on_complete_batch``)."""
+        faults.inject("serve:dispatch")
         t0 = time.perf_counter()
         samples: List[tuple] = []
         lookups: List[ServeFuture] = []
@@ -258,35 +297,16 @@ class LookupServer:
             else:
                 lookups.append(req)
         if lookups:
-            # find_rows_many decomposed so the coalesced batch's two
-            # phases carry their own timestamps; each request's trace
-            # gets both as batch-shared children of its dispatch span
-            try:
-                tb0 = time.perf_counter()
-                bounds = self._impl.bounds_many([r.probe for r in lookups])
-                tb1 = time.perf_counter()
-                groups = self._impl.rows_for_bounds(bounds)
-                tb2 = time.perf_counter()
-            except Exception as err:
-                for req in lookups:
-                    self._complete(req, None, err, samples, batch_n=len(lookups))
-            else:
-                phases = (
-                    ("serve:bounds", tb0, tb1),
-                    ("serve:gather-decode", tb1, tb2),
-                )
-                for req, rows in zip(lookups, groups):
-                    # clone on delivery: blocks may be shared with the
-                    # mirror LRU (same contract as iterate/_rows_hint)
-                    self._complete(
-                        req,
-                        [Row(r) for r in rows],
-                        None,
-                        samples,
-                        batch_n=len(lookups),
-                        phases=phases,
-                    )
+            self._run_lookups(lookups, samples)
         for req in plans:
+            # a long lookup phase, retries, or earlier plans in THIS
+            # batch may have consumed a plan request's whole budget
+            # since the drain-time sweep: re-check with a fresh clock
+            # before paying for the execution
+            expired = self.admission.deadline_error(req.t_submit, req.deadline_s)
+            if expired is not None:
+                self._complete(req, None, expired, samples)
+                continue
             # plans execute under the submitter's adopted context inside
             # an open dispatch span, so the executor's per-node stages
             # (telemetry.stage shim) nest inside it in the right trace
@@ -295,7 +315,7 @@ class LookupServer:
                     "serve:dispatch", kind="plan", batch=len(batch)
                 )
                 try:
-                    value = self.plancache.execute(req.plan)
+                    value = self._execute_plan_with_retry(req)
                 except Exception as err:
                     tracer.close_span(handle, error=True)
                     self._complete(req, None, err, samples, own_dispatch=True)
@@ -305,6 +325,142 @@ class LookupServer:
         self.metrics.on_batch(len(batch))
         self.metrics.on_complete_batch(samples)
         self.metrics.observe_dispatch(len(batch), time.perf_counter() - t0)
+
+    def _run_lookups(self, lookups: List[ServeFuture], samples: List[tuple]) -> None:
+        """One coalesced batched lookup with the recovery ladder:
+        bounded deadline-aware retries on transient device failures,
+        then — retries exhausted or breaker open — the host-fallback
+        oracle (bitwise-identical results).  Non-transient failures
+        surface typed to every request in the sub-batch."""
+        probes = [r.probe for r in lookups]
+
+        def time_left():
+            # tightest remaining deadline budget across the sub-batch
+            # (None = unbounded): a retry must never sleep past it
+            now = time.perf_counter()
+            budgets = [
+                r.deadline_s - (now - r.t_submit)
+                for r in lookups
+                if r.deadline_s is not None
+            ]
+            return min(budgets) if budgets else None
+
+        def primary_pass():
+            # find_rows_many decomposed so the coalesced batch's two
+            # phases carry their own timestamps; each request's trace
+            # gets both as batch-shared children of its dispatch span
+            t_a = time.perf_counter()
+            faults.inject("serve:bounds")
+            bounds = self._impl.bounds_many(probes)
+            t_b = time.perf_counter()
+            groups = self._impl.rows_for_bounds(bounds)
+            return t_a, t_b, time.perf_counter(), groups
+
+        def fallback_pass():
+            t_a = time.perf_counter()
+            bounds = self._oracle.bounds_many(probes)
+            t_b = time.perf_counter()
+            groups = self._oracle.rows_for_bounds(bounds)
+            return t_a, t_b, time.perf_counter(), groups
+
+        def on_retry(attempt, err):
+            self.metrics.on_retry()
+            self.breaker.on_failure()
+
+        degraded = self.breaker.route() == "fallback"
+        try:
+            if degraded:
+                t_a, t_b, t_c, groups = fallback_pass()
+            else:
+                try:
+                    t_a, t_b, t_c, groups = call_with_retry(
+                        primary_pass,
+                        policy=self.retry_policy,
+                        time_left=time_left,
+                        on_retry=on_retry,
+                        site="serve:bounds",
+                    )
+                    self.breaker.on_success()
+                except Exception as err:
+                    self.breaker.on_failure()
+                    if classify(err) != TRANSIENT:
+                        raise
+                    # retries exhausted on a transient device failure:
+                    # serve the batch from the host oracle instead of
+                    # failing it back to callers
+                    degraded = True
+                    t_a, t_b, t_c, groups = fallback_pass()
+        except Exception as err:
+            for req in lookups:
+                self._complete(req, None, err, samples, batch_n=len(lookups))
+            return
+        if degraded:
+            self.metrics.on_degraded(len(lookups))
+        phases = (
+            ("serve:bounds", t_a, t_b),
+            ("serve:gather-decode", t_b, t_c),
+        )
+        for req, rows in zip(lookups, groups):
+            # clone on delivery: blocks may be shared with the
+            # mirror LRU (same contract as iterate/_rows_hint)
+            self._complete(
+                req,
+                [Row(r) for r in rows],
+                None,
+                samples,
+                batch_n=len(lookups),
+                phases=phases,
+            )
+
+    def _execute_plan_with_retry(self, req: ServeFuture):
+        """Execute one plan query through the cache, retrying transient
+        device failures within the request's remaining deadline.  The
+        cached executable is reused across attempts — the chaos gate
+        asserts retries cause zero warm recompiles."""
+        if req.deadline_s is not None:
+            deadline_s = req.deadline_s
+            t_submit = req.t_submit
+
+            def time_left():
+                return deadline_s - (time.perf_counter() - t_submit)
+
+        else:
+            time_left = None
+
+        def on_retry(attempt, err):
+            self.metrics.on_retry()
+
+        return call_with_retry(
+            lambda: self.plancache.execute(req.plan),
+            policy=self.retry_policy,
+            time_left=time_left,
+            on_retry=on_retry,
+            site="plan:execute",
+        )
+
+    def _on_dispatcher_crash(
+        self, err: BaseException, inflight: List[ServeFuture]
+    ) -> None:
+        """Terminal failure path: record the crash (post-mortem submits
+        raise it at admission), close the server, and complete every
+        in-flight and still-pending request with a typed
+        :class:`ServerCrashed` — clients unblock in well under a second
+        instead of hanging on futures nobody will ever complete."""
+        crash = ServerCrashed(err)
+        with self._cv:
+            self._crashed = crash
+            orphans, self._pending = self._pending, []
+            self._open = False
+            self._cv.notify_all()
+        sys.stderr.write(
+            f"csvplus-serve: dispatcher crashed "
+            f"({type(err).__name__}: {err}); failing "
+            f"{len(inflight) + len(orphans)} request(s) with ServerCrashed\n"
+        )
+        samples: List[tuple] = []
+        for req in list(inflight) + orphans:
+            self._complete(req, None, crash, samples)
+        self.metrics.on_complete_batch(samples)
 
     def _complete(
         self,
@@ -316,6 +472,11 @@ class LookupServer:
         phases: Sequence[tuple] = (),
         own_dispatch: bool = False,
     ) -> None:
+        if req._done:
+            # already delivered — e.g. completed earlier in a batch the
+            # dispatcher then crashed out of; never double-complete
+            return
+        req._done = True
         req.value = value
         req.error = error
         done = time.perf_counter()
@@ -354,10 +515,16 @@ class LookupServer:
         if req.callback is not None:
             try:
                 req.callback(req)
-            except Exception:
-                # a caller's callback must not kill the dispatcher; the
-                # failure is theirs (the request itself completed)
-                pass
+            except Exception as cb_err:
+                # a caller's callback must not kill the dispatcher (the
+                # request itself completed) — but the failure is never
+                # dropped: counted and warned once per occurrence
+                self.metrics.on_callback_error()
+                sys.stderr.write(
+                    f"csvplus-serve: completion callback raised "
+                    f"{type(cb_err).__name__}: {cb_err} (request completed; "
+                    f"see metrics callback_errors)\n"
+                )
         else:
             req._event.set()
 

@@ -141,6 +141,9 @@ class ServingMetrics:
         self.shed = 0  # rejected with ServerOverloaded at admission
         self.expired = 0  # completed with DeadlineExceeded before dispatch
         self.failed = 0  # completed with any other error
+        self.retried = 0  # transient-failure retries of dispatched work
+        self.degraded = 0  # requests served via the host-fallback path
+        self.callback_errors = 0  # completion callbacks that raised
         self.queue_depth_last = 0  # depth observed at the latest drain
         self.queue_depth_max = 0
         self.batches = BatchHistogram()
@@ -159,6 +162,23 @@ class ServingMetrics:
     def on_batch(self, size: int) -> None:
         with self._lock:
             self.batches.record(size)
+
+    def on_retry(self, n: int = 1) -> None:
+        """A transient failure on dispatched work is being retried."""
+        with self._lock:
+            self.retried += n
+
+    def on_degraded(self, n: int = 1) -> None:
+        """*n* requests were served by the host-fallback (degraded)
+        path instead of the primary device path."""
+        with self._lock:
+            self.degraded += n
+
+    def on_callback_error(self) -> None:
+        """A caller's completion callback raised (the request itself
+        completed; the callback failure is counted, never dropped)."""
+        with self._lock:
+            self.callback_errors += 1
 
     def on_complete(
         self, latency_s: float, wait_s: float, outcome: str = "ok"
@@ -206,6 +226,9 @@ class ServingMetrics:
                 "shed": self.shed,
                 "expired": self.expired,
                 "failed": self.failed,
+                "retried": self.retried,
+                "degraded": self.degraded,
+                "callback_errors": self.callback_errors,
                 "queue_depth_last": self.queue_depth_last,
                 "queue_depth_max": self.queue_depth_max,
                 "batch": self.batches.snapshot(),

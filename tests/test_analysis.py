@@ -355,6 +355,66 @@ def test_astlint_jit_suppression_on_def_line():
     assert lint_source(JIT_SUPPRESSED) == []
 
 
+FAULT_BAD = """
+def f(items):
+    out = []
+    for x in items:
+        try:
+            out.append(int(x))
+        except Exception:
+            pass
+    return out
+"""
+
+FAULT_BAD_TUPLE_CONTINUE = """
+def f(items):
+    for x in items:
+        try:
+            x.close()
+        except (ValueError, BaseException):
+            continue
+"""
+
+FAULT_OK = """
+def f(items):
+    out = []
+    for x in items:
+        try:
+            out.append(int(x))
+        except (ValueError, TypeError):
+            pass  # narrow catch: the swallowed set is an explicit policy
+        try:
+            x.close()
+        except Exception:
+            return None  # not a swallow: the failure changes the result
+    return out
+"""
+
+FAULT_SUPPRESSED = """
+def f(items):  # analysis: allow[FAULT001]
+    for x in items:
+        try:
+            x.close()
+        except Exception:
+            pass
+"""
+
+
+def test_astlint_fault_fires_on_silent_broad_except():
+    (f,) = lint_source(FAULT_BAD)
+    assert f.code == "FAULT001" and "silently swallows" in f.message
+    (g,) = lint_source(FAULT_BAD_TUPLE_CONTINUE)
+    assert g.code == "FAULT001"
+
+
+def test_astlint_fault_silent_on_narrow_or_handled():
+    assert lint_source(FAULT_OK) == []
+
+
+def test_astlint_fault_suppression_on_def_line():
+    assert lint_source(FAULT_SUPPRESSED) == []
+
+
 def test_repo_tree_is_lint_clean():
     """The `make lint` AST pass over the real package must be silent —
     outstanding findings are fixed or explicitly acknowledged in code."""

@@ -1,0 +1,479 @@
+"""Chaos differential suite (csvplus_tpu.resilience, docs/RESILIENCE.md).
+
+Contracts under test, per the ISSUE 8 recovery ladder:
+
+* serve retry — transient device failures on the coalesced lookup are
+  absorbed by bounded deadline-aware retries; recovered results are
+  bitwise-equal to the serial fault-free oracle and cause ZERO warm
+  recompiles (the cached executables are simply re-executed);
+* graceful degradation — retries exhausting trips the circuit breaker
+  onto the host-fallback oracle (bitwise-identical results, ``degraded``
+  counted), and a half-open probe recovers the device path;
+* typed surfacing — non-transient failures reach callers as their own
+  error types; a dispatcher death fails every pending and future
+  request fast with :class:`ServerCrashed` instead of hanging;
+* deadline integrity under faults — stragglers expire queued requests
+  at drain time, and a slow plan earlier in a batch expires later plans
+  at the fresh re-check, never silently late;
+* ingest recovery — a crashed scan+encode worker's chunk is re-executed
+  and the emitted stream is bitwise-identical to the fault-free run for
+  every worker count (K stays unobservable); injected read errors
+  surface as :class:`DataSourceError` with K-independent row numbers;
+* determinism — a :class:`FaultPlan` fires identically across runs of
+  the same workload (specs + seed + hit counters, never wall time).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import csvplus_tpu as cp
+from csvplus_tpu import DataSourceError, from_file
+from csvplus_tpu.columnar.table import DeviceTable
+from csvplus_tpu.obs.recompile import RecompileWatch
+from csvplus_tpu.resilience import faults
+from csvplus_tpu.resilience.degrade import CircuitBreaker, HostLookupOracle
+from csvplus_tpu.resilience.faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedDeviceError,
+    InjectedFatalError,
+    InjectedWorkerCrash,
+    plan_from_env,
+)
+from csvplus_tpu.resilience.retry import (
+    DATA,
+    FATAL,
+    TRANSIENT,
+    RetryPolicy,
+    ServerCrashed,
+    call_with_retry,
+    classify,
+)
+from csvplus_tpu.serve import DeadlineExceeded, LookupServer, PlanCache
+
+native = pytest.importorskip("csvplus_tpu.native.scanner")
+
+#: Fast-converging retry policy for tests: same shape, microsecond sleeps.
+FAST_RETRY = dict(max_attempts=3, base_s=1e-4, cap_s=1e-3)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends with fault injection disarmed — a
+    leaked plan would poison unrelated suites' device calls."""
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+def _build(n=2000):
+    ids = np.arange(n, dtype=np.int64) * 7 % (n * 3)
+    t = DeviceTable.from_pylists(
+        {
+            "id": np.char.add("c", ids.astype(np.str_)).tolist(),
+            "v": np.arange(n).astype(np.str_).tolist(),
+        },
+        device="cpu",
+    )
+    return cp.take(t).index_on("id").sync(), ids
+
+
+@pytest.fixture(scope="module")
+def served():
+    return _build()
+
+
+def _probes(ids, n, seed=0):
+    rng = np.random.default_rng(seed)
+    ps = [f"c{int(v)}" for v in rng.choice(ids, n)]
+    ps[::17] = ["nope"] * len(ps[::17])  # sprinkle misses
+    return ps
+
+
+# -- serve: retry absorbs transient device failures ------------------------
+
+
+def test_serve_retry_recovers_bitwise_zero_recompiles(served):
+    idx, ids = served
+    probes = _probes(ids, 120)
+    serial = [idx.find(p).to_rows() for p in probes]
+    with LookupServer(idx) as srv:
+        srv.retry_policy = RetryPolicy(**FAST_RETRY)
+        # warm every kernel/executable on the lookup path first, so the
+        # watched region isolates the retry machinery
+        for f in [srv.submit(p) for p in probes[:20]]:
+            f.result(timeout=30.0)
+        with RecompileWatch() as w:
+            with faults.active(
+                FaultPlan(
+                    [{"site": "serve:bounds", "at": [0, 2], "error": "device"}],
+                    seed=3,
+                )
+            ) as plan:
+                futs = [srv.submit(p) for p in probes]
+                got = [f.result(timeout=30.0) for f in futs]
+        w.assert_zero("retried serve lookups")
+        snap = srv.snapshot()
+    assert got == serial
+    assert plan.snapshot()["fired"]["serve:bounds"] >= 1
+    assert snap["retried"] >= 1
+    assert snap["failed"] == 0 and snap["degraded"] == 0
+
+
+def test_serve_breaker_degrades_to_host_and_recovers(served):
+    idx, ids = served
+    probes = _probes(ids, 60, seed=4)
+    serial = [idx.find(p).to_rows() for p in probes]
+    with LookupServer(idx) as srv:
+        srv.retry_policy = RetryPolicy(max_attempts=2, base_s=1e-4, cap_s=1e-3)
+        srv.breaker = CircuitBreaker(threshold=2, cooldown_s=0.05)
+        with faults.active(
+            FaultPlan([{"site": "serve:bounds", "every": 1, "error": "device"}])
+        ):
+            # EVERY primary pass fails: retries exhaust, the breaker
+            # trips, and the whole load is served by the host fallback
+            futs = [srv.submit(p) for p in probes]
+            got = [f.result(timeout=30.0) for f in futs]
+        snap = srv.snapshot()
+        assert got == serial  # bitwise parity through the fallback
+        assert snap["failed"] == 0
+        assert snap["degraded"] >= len(probes)
+        assert snap["retried"] >= 1
+        assert srv.breaker.state == "open"
+        assert srv.breaker.snapshot()["opened_total"] >= 1
+        # faults disarmed + cooldown elapsed: the half-open probe rides
+        # the primary path, succeeds, and closes the breaker
+        time.sleep(0.06)
+        again = [srv.submit(p) for p in probes[:10]]
+        assert [f.result(timeout=30.0) for f in again] == serial[:10]
+        assert srv.breaker.state == "closed"
+
+
+def test_serve_fatal_surfaces_typed_server_survives(served):
+    idx, ids = served
+    probe = f"c{int(ids[5])}"
+    with LookupServer(idx) as srv:
+        with faults.active(
+            FaultPlan([{"site": "serve:bounds", "at": [0], "error": "fatal"}])
+        ):
+            fut = srv.submit(probe)
+            with pytest.raises(InjectedFatalError):
+                fut.result(timeout=30.0)
+        # the dispatcher survived a non-transient batch failure: the
+        # server keeps serving once the fault is disarmed
+        assert srv.submit(probe).result(timeout=30.0) == idx.find(probe).to_rows()
+        assert srv.snapshot()["failed"] == 1
+
+
+def test_dispatcher_crash_fails_pending_and_future_fast(served):
+    idx, ids = served
+    srv = LookupServer(idx, tick_us=20000)  # hold the batch open: all
+    srv.start()  # submits below coalesce into the doomed first dispatch
+    try:
+        with faults.active(
+            FaultPlan([{"site": "serve:dispatch", "at": [0], "error": "fatal"}])
+        ):
+            futs = []
+            for v in ids[:8]:
+                try:
+                    futs.append(srv.submit(f"c{int(v)}"))
+                except ServerCrashed:
+                    break  # crash landed mid-submission: also typed+fast
+            assert futs, "at least the first submit must be admitted"
+            t0 = time.perf_counter()
+            for f in futs:
+                with pytest.raises(ServerCrashed) as ei:
+                    f.result(timeout=1.0)
+                assert isinstance(ei.value.cause, InjectedFatalError)
+            # the hard bound under test: admitted futures unblock well
+            # under a second, never hang on a dead dispatcher
+            assert time.perf_counter() - t0 < 1.0
+        # post-mortem submits fail fast and typed at admission
+        with pytest.raises(ServerCrashed):
+            srv.submit(f"c{int(ids[0])}")
+    finally:
+        srv.stop()
+
+
+def test_straggler_expires_queued_deadline_at_drain(served):
+    idx, ids = served
+    probe = f"c{int(ids[7])}"
+    with LookupServer(idx) as srv:
+        with faults.active(
+            FaultPlan(
+                [{"site": "serve:dispatch", "kind": "delay", "at": [0],
+                  "delay_s": 0.08}]
+            )
+        ):
+            a = srv.submit(probe)
+            # wait for a's batch to drain (on_tick precedes the injected
+            # straggler delay), then queue b behind the busy dispatcher
+            while srv.metrics.ticks == 0:
+                time.sleep(0.001)
+            b = srv.submit(probe, deadline_s=0.005)
+            assert a.result(timeout=30.0) == idx.find(probe).to_rows()
+            with pytest.raises(DeadlineExceeded):
+                b.result(timeout=30.0)
+        assert srv.snapshot()["expired"] == 1
+
+
+def test_slow_plan_expires_later_plan_at_fresh_recheck(served):
+    idx, ids = served
+    pa = idx.find(f"c{int(ids[1])}").plan
+    pb = idx.find(f"c{int(ids[2])}").plan
+    # a fixed ticker coalesces both plans into ONE batch; the injected
+    # delay makes plan a consume plan b's whole budget AFTER the
+    # drain-time sweep passed it — only the fresh per-plan re-check
+    # can expire it before paying for the execution
+    with LookupServer(idx, tick_us=5000) as srv:
+        with faults.active(
+            FaultPlan(
+                [{"site": "exec:device", "kind": "delay", "at": [0],
+                  "delay_s": 0.2}]
+            )
+        ):
+            a = srv.submit_plan(pa)
+            b = srv.submit_plan(pb, deadline_s=0.05)
+            got = a.result(timeout=30.0)
+            with pytest.raises(DeadlineExceeded):
+                b.result(timeout=30.0)
+        assert cp.take(got).to_rows() == idx.find(f"c{int(ids[1])}").to_rows()
+        assert srv.snapshot()["expired"] == 1
+
+
+def test_plan_execute_retry_bitwise_zero_recompiles(served):
+    idx, ids = served
+    plan = idx.find(f"c{int(ids[3])}").plan
+    pc = PlanCache()
+    expected = cp.take(pc.execute(plan)).to_rows()  # warm the executable
+    with RecompileWatch(plancache=pc) as w:
+        with faults.active(
+            FaultPlan([{"site": "exec:device", "at": [0], "error": "device"}])
+        ):
+            got = call_with_retry(
+                lambda: pc.execute(plan), policy=RetryPolicy(**FAST_RETRY)
+            )
+    w.assert_zero("retried plan execution")
+    assert cp.take(got).to_rows() == expected
+
+
+def test_callback_error_counted_not_dropped(served, capsys):
+    idx, ids = served
+    probe = f"c{int(ids[9])}"
+    with LookupServer(idx) as srv:
+        srv.submit(probe, callback=lambda fut: (_ for _ in ()).throw(
+            RuntimeError("consumer bug")))
+        deadline = time.perf_counter() + 5.0
+        while srv.metrics.callback_errors == 0:
+            assert time.perf_counter() < deadline, "callback error never counted"
+            time.sleep(0.001)
+        # the request itself completed normally despite the bad callback
+        assert srv.submit(probe).result(timeout=30.0) == idx.find(probe).to_rows()
+        assert srv.snapshot()["callback_errors"] == 1
+    assert "completion callback raised RuntimeError" in capsys.readouterr().err
+
+
+# -- ingest: worker crashes stay unobservable ------------------------------
+
+
+def _chaos_csv(tmp_path, rows=400):
+    p = tmp_path / "chaos.csv"
+    lines = ["k,v"] + [f"k{i},v{i * 3}" for i in range(rows)]
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def _stream_fold(path, workers, chunk_bytes=256):
+    """One staged-pipeline run folded to a comparable value: the full
+    per-chunk yield sequence, or the exception type + message + the
+    chunk prefix that emitted before it."""
+    out = []
+    try:
+        for names, encoded, n in native.stream_encoded_chunks(
+            from_file(path), path, chunk_bytes=chunk_bytes, workers=workers
+        ):
+            chunk = {}
+            for c, enc in encoded.items():
+                if len(enc) == 3 and enc[0] == "int":
+                    chunk[c] = ("typed", enc[1], enc[2].tolist())
+                else:
+                    chunk[c] = (
+                        "dict",
+                        [bytes(x) for x in enc[0].tolist()],
+                        np.asarray(enc[1]).tolist(),
+                    )
+            out.append((tuple(names), chunk, n))
+    except DataSourceError as e:
+        return ("exc", type(e).__name__, str(e), out)
+    return ("ok", out)
+
+
+def test_ingest_worker_crash_recovery_unobservable(tmp_path):
+    path = _chaos_csv(tmp_path)
+    oracle = _stream_fold(path, workers=1)
+    assert oracle[0] == "ok" and len(oracle[1]) > 4, "need a multi-chunk file"
+    for k in (1, 2, 4):
+        with faults.active(
+            FaultPlan([{"site": "ingest:worker", "at": [1, 3, 4],
+                        "error": "crash"}])
+        ) as plan:
+            got = _stream_fold(path, workers=k)
+        assert plan.snapshot()["fired"]["ingest:worker"] >= 1
+        # re-executed chunks slot into the same file-order positions:
+        # the emitted stream is bitwise-identical to the fault-free run
+        assert got == oracle, f"worker crash observable at K={k}"
+
+
+def test_ingest_worker_crash_exhaustion_surfaces_typed(tmp_path):
+    path = _chaos_csv(tmp_path)
+    for k in (1, 3):
+        with faults.active(
+            FaultPlan([{"site": "ingest:worker", "every": 1, "error": "crash"}])
+        ):
+            with pytest.raises(InjectedWorkerCrash):
+                list(
+                    native.stream_encoded_chunks(
+                        from_file(path), path, chunk_bytes=256, workers=k
+                    )
+                )
+
+
+def test_ingest_read_fault_typed_rows_k_independent(tmp_path):
+    path = _chaos_csv(tmp_path)
+    # an I/O failure mid-file: the chunks already cut still emit, then a
+    # DataSourceError carries the absolute 1-based record number — the
+    # SAME outcome tuple (message + emitted prefix) for every K
+    outcomes = {}
+    for k in (1, 2):
+        with faults.active(
+            FaultPlan([{"site": "ingest:read", "at": [2], "error": "io"}])
+        ):
+            outcomes[k] = _stream_fold(path, workers=k)
+    assert outcomes[1][0] == "exc" and outcomes[1][1] == "DataSourceError"
+    assert outcomes[1] == outcomes[2]
+    # a failure on the very first read is numbered row 1, the same
+    # typed shape as a missing file
+    with faults.active(
+        FaultPlan([{"site": "ingest:read", "at": [0], "error": "io"}])
+    ):
+        first = _stream_fold(path, workers=1)
+    assert first[0] == "exc" and first[1] == "DataSourceError"
+    assert "row 1:" in first[2] and first[3] == []
+
+
+# -- unit: taxonomy, breaker, plan determinism -----------------------------
+
+
+def test_classify_taxonomy():
+    assert classify(InjectedDeviceError("x")) == TRANSIENT
+    assert classify(InjectedWorkerCrash("x")) == TRANSIENT
+    assert classify(RuntimeError("RESOURCE_EXHAUSTED: out of memory")) == TRANSIENT
+    assert classify(InjectedFatalError("x")) == FATAL
+    assert classify(ServerCrashed(RuntimeError("boom"))) == FATAL
+    assert classify(RuntimeError("segfault adjacent")) == FATAL
+    assert classify(DataSourceError(3, "bad row")) == DATA
+    assert classify(DeadlineExceeded(0.2, 0.1)) == DATA
+    assert classify(OSError("disk")) == DATA
+    assert classify(ValueError("shape")) == DATA
+
+
+def test_call_with_retry_policy_bounds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        raise InjectedDeviceError("always")
+
+    with pytest.raises(InjectedDeviceError):
+        call_with_retry(flaky, policy=RetryPolicy(**FAST_RETRY))
+    assert calls["n"] == 3  # max_attempts bounds total calls
+    # data-class errors are never retried
+    calls["n"] = 0
+
+    def broken():
+        calls["n"] += 1
+        raise ValueError("bad input")
+
+    with pytest.raises(ValueError):
+        call_with_retry(broken, policy=RetryPolicy(**FAST_RETRY))
+    assert calls["n"] == 1
+    # an exhausted deadline budget forbids the backoff sleep
+    calls["n"] = 0
+    with pytest.raises(InjectedDeviceError):
+        call_with_retry(
+            flaky, policy=RetryPolicy(**FAST_RETRY), time_left=lambda: 0.0
+        )
+    assert calls["n"] == 1
+
+
+def test_circuit_breaker_states():
+    t = [0.0]
+    br = CircuitBreaker(threshold=2, cooldown_s=1.0, clock=lambda: t[0])
+    assert br.route() == "primary" and br.state == "closed"
+    br.on_failure()
+    assert br.state == "closed"  # below threshold
+    br.on_failure()
+    assert br.state == "open"
+    assert br.route() == "fallback"  # cooldown not elapsed
+    t[0] = 1.5
+    assert br.route() == "primary"  # the half-open probe
+    assert br.route() == "fallback"  # one probe at a time
+    br.on_failure()  # probe failed: re-open, fresh cooldown
+    assert br.state == "open" and br.route() == "fallback"
+    t[0] = 3.0
+    assert br.route() == "primary"
+    br.on_success()
+    assert br.state == "closed" and br.route() == "primary"
+    assert br.snapshot()["opened_total"] == 2
+
+
+def test_fault_plan_deterministic_and_env_parsed():
+    spec = [{"site": "exec:device", "p": 0.5, "error": "device"}]
+
+    def firing_pattern(plan, n=40):
+        out = []
+        for _ in range(n):
+            try:
+                plan.fire("exec:device")
+                out.append(0)
+            except InjectedDeviceError:
+                out.append(1)
+        return out
+
+    a = firing_pattern(FaultPlan(spec, seed=7))
+    b = firing_pattern(FaultPlan(spec, seed=7))
+    assert a == b and 0 < sum(a) < 40  # same seed => identical schedule
+    assert firing_pattern(FaultPlan(spec, seed=8)) != a
+    # env arming parses both accepted JSON shapes
+    env = {"CSVPLUS_FAULTS": '{"seed": 7, "faults": [{"site": "serve:bounds",'
+                             ' "at": [1], "error": "fatal"}]}'}
+    plan = plan_from_env(env)
+    assert plan.seed == 7 and plan.specs[0].site == "serve:bounds"
+    assert plan_from_env({"CSVPLUS_FAULTS": '[{"site": "ingest:read"}]'}) is not None
+    assert plan_from_env({}) is None
+    # spec validation rejects unknown sites/kinds and over-constrained schedules
+    with pytest.raises(ValueError):
+        FaultSpec("nope:where")
+    with pytest.raises(ValueError):
+        FaultSpec("serve:bounds", kind="explode")
+    with pytest.raises(ValueError):
+        FaultSpec("serve:bounds", at=[0], every=2)
+
+
+def test_host_oracle_leaves_primary_device_path_intact(served):
+    idx, ids = served
+    impl = idx._impl
+    oracle = HostLookupOracle(impl)
+    probes = [(p,) for p in _probes(ids, 30, seed=5)]
+    dev_bounds = impl.bounds_many(probes)
+    host_bounds = oracle.bounds_many(probes)
+    assert [tuple(map(int, b)) for b in dev_bounds] == [
+        tuple(map(int, b)) for b in host_bounds
+    ]
+    assert impl.rows_for_bounds(dev_bounds) == oracle.rows_for_bounds(host_bounds)
+    # the fallback build must NOT have materialized the primary impl's
+    # host rows — that would permanently flip it off the device path
+    assert impl._rows is None

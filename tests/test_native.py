@@ -636,3 +636,78 @@ def test_scan_threads_env_cap(monkeypatch):
         for a, b in zip(want[:3], got[:3]):
             assert np.array_equal(a, b)
         assert want[3] == got[3]
+
+
+# -- source error paths (ISSUE 8 satellite): typed, row-numbered -----------
+
+
+def test_stream_missing_file_typed_row1(tmp_path):
+    """A nonexistent source surfaces as DataSourceError numbered at row
+    1 ("the source failed before the first record") on BOTH native
+    entry points, never a bare FileNotFoundError."""
+    path = str(tmp_path / "nope.csv")
+    with pytest.raises(DataSourceError) as e:
+        list(native.stream_encoded_chunks(from_file(path), path, chunk_bytes=256))
+    assert e.value.line == 1 and "open:" in str(e.value)
+    with pytest.raises(DataSourceError) as e2:
+        native.read_columns_native(from_file(path), path)
+    assert e2.value.line == 1 and "open:" in str(e2.value)
+
+
+def test_stream_unreadable_file_typed_row1(tmp_path):
+    import os
+
+    p = tmp_path / "locked.csv"
+    p.write_text("a,b\n1,2\n")
+    p.chmod(0)
+    try:
+        if os.access(str(p), os.R_OK):
+            pytest.skip("cannot drop read permission (running privileged)")
+        with pytest.raises(DataSourceError) as e:
+            list(
+                native.stream_encoded_chunks(
+                    from_file(str(p)), str(p), chunk_bytes=256
+                )
+            )
+        assert e.value.line == 1 and "open:" in str(e.value)
+    finally:
+        p.chmod(0o644)
+
+
+def test_stream_directory_path_typed_row1(tmp_path):
+    """Opening a directory is an OSError shape distinct from ENOENT —
+    still typed and numbered at row 1."""
+    path = str(tmp_path)
+    with pytest.raises(DataSourceError) as e:
+        list(native.stream_encoded_chunks(from_file(path), path, chunk_bytes=256))
+    assert e.value.line == 1 and "open:" in str(e.value)
+
+
+def test_stream_truncated_quote_matches_whole_file_error(tmp_path):
+    """A file truncated mid-quoted-field (EOF inside an open quote)
+    raises the SAME DataSourceError — type, row number, message — from
+    the streaming tier at every worker count as from the whole-file
+    scan, and the python spec parser agrees on the message."""
+    text = (
+        "a,b\n"
+        + "".join(f"k{i},v{i}\n" for i in range(50))
+        + '"truncated mid-field,oops'
+    )
+    with pytest.raises(CsvParseError) as pe:
+        python_records(text)
+    with pytest.raises(DataSourceError) as we:
+        native_records(text)
+    assert str(pe.value) in str(we.value)
+
+    p = tmp_path / "trunc.csv"
+    p.write_text(text)
+    path = str(p)
+    for workers in (1, 2):
+        with pytest.raises(DataSourceError) as se:
+            list(
+                native.stream_encoded_chunks(
+                    from_file(path), path, chunk_bytes=64, workers=workers
+                )
+            )
+        assert se.value.line == we.value.line
+        assert str(se.value) == str(we.value)
