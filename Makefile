@@ -1,14 +1,15 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve trace-smoke chaos check dryrun example coldcheck lint analyze asan
+.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta trace-smoke chaos check dryrun example coldcheck lint analyze asan
 
 test:
 	python -m pytest tests/ -x -q
 
 # The standing local gate: unit suite, static analysis, chaos
-# differential — the set a change must keep green before review.
-check: test lint chaos
+# differential, mutable-index storage bench — the set a change must
+# keep green before review.
+check: test lint chaos bench-delta
 
 # Static analysis gate (docs/ANALYSIS.md).  The repo AST lint (ctypes
 # boundary + jit retrace rules) always runs; ruff and mypy run when
@@ -93,6 +94,18 @@ bench-ingest:
 bench-serve:
 	JAX_PLATFORMS=cpu python bench_serve.py
 
+# Mutable-index storage gate (docs/STORAGE.md): append rows/s through
+# the delta-tier write path, single-probe lookup p50/p99 at 0/4/16
+# live deltas, and reader-observed latency during a concurrent
+# compaction — with the ISSUE 9 hard contract enforced in-bench
+# (checksum parity vs a from-scratch rebuild after every compaction
+# step, zero warm recompiles).  One compact JSON line last; exits
+# nonzero on a >2x regression vs bench_delta_floor.json.  The
+# checked-in record (BENCH_DELTA_r10.json) is only (re)written when
+# CSVPLUS_BENCH_DELTA_OUT is set.
+bench-delta:
+	JAX_PLATFORMS=cpu python bench_delta.py
+
 # Tracing-subsystem smoke (docs/OBSERVABILITY.md): a traced serving
 # pass on the micro lookup shape must produce per-request span trees,
 # the Chrome-trace export must pass the schema validator, and the
@@ -109,7 +122,7 @@ trace-smoke:
 # typed (dispatcher crashes fail every pending future with
 # ServerCrashed in <1s); every case runs under a watchdog so a hang is
 # a failure; the DISARMED injection hooks must cost <=1% of a served
-# request.  Writes CHAOS_r09.json; the unit-level chaos suite
+# request.  Writes CHAOS_r10.json; the unit-level chaos suite
 # (tests/test_chaos.py) runs first.
 chaos:
 	JAX_PLATFORMS=cpu timeout -k 10 600 python -m pytest tests/test_chaos.py -q

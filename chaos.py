@@ -1,9 +1,10 @@
 #!/usr/bin/env python
 """`make chaos`: seeded fault-injection differential gate (ISSUE 8, r09).
 
-Runs seeded fault schedules against the three workload shapes —
-serve load, K-worker streamed ingest, and the 8-way mesh join — and
-holds the recovery ladder to the differential contract:
+Runs seeded fault schedules against the four workload shapes —
+serve load, K-worker streamed ingest, the 8-way mesh join, and the
+mutable-index compactor — and holds the recovery ladder to the
+differential contract:
 
 * when recovery is possible (transient device faults within the retry
   budget, breaker fallback, crashed ingest workers) the results must be
@@ -20,7 +21,7 @@ holds the recovery ladder to the differential contract:
   `make trace-smoke`'s disabled-hook gate).
 
 Contract (matches the benches): diagnostics go to stderr, stdout
-carries ONE compact JSON line; CHAOS_r09.json records the full
+carries ONE compact JSON line; CHAOS_r10.json records the full
 evidence — per-case injection counts (``FaultPlan.snapshot``), recovery
 outcomes, serve retry/degrade metrics, telemetry counters
 (``ingest.worker_recovered``), and the overhead measurement.  Exits
@@ -52,7 +53,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 #: Watchdog bound per chaos case: a case that cannot finish inside this
 #: is a hang, which is exactly what the resilience layer must prevent.
 CASE_TIMEOUT_S = float(os.environ.get("CSVPLUS_CHAOS_CASE_TIMEOUT", 120))
-ARTIFACT = os.path.join(REPO, "CHAOS_r09.json")
+ARTIFACT = os.path.join(REPO, "CHAOS_r10.json")
 #: Disarmed-hook budget: injection sites on the serve path may cost at
 #: most this fraction of one served request.
 OVERHEAD_BUDGET_PCT = 1.0
@@ -399,6 +400,77 @@ def case_mesh_join_under_ingest_faults(tmp_root):
     }
 
 
+# ---- storage: compactor crash safety (ISSUE 9) ---------------------------
+
+
+def case_storage_compact_crash():
+    """A compactor crash — at entry or in the pre-swap window — must
+    leave the pre-compaction tier set intact (same epoch, same deltas,
+    same answers) and a retry must compact to full rebuild parity."""
+    from csvplus_tpu.resilience import faults
+    from csvplus_tpu.resilience.faults import FaultPlan, InjectedFatalError
+    from csvplus_tpu.row import Row
+    from csvplus_tpu.source import take_rows
+    from csvplus_tpu.storage import (
+        MutableIndex,
+        index_checksums,
+        rebuild_reference,
+    )
+
+    mi = MutableIndex.create(
+        take_rows([Row({"k": f"k{i % 41:03d}", "v": f"v{i}"}) for i in range(800)]),
+        ["k"],
+        ingest_device="cpu",
+    )
+    mi.append_rows([{"k": f"n{j}", "v": "x"} for j in range(30)])
+    mi.append_rows([{"k": f"m{j}", "v": "y"} for j in range(20)])
+    probes = [(f"k{i:03d}",) for i in range(0, 41, 3)] + [("n5",), ("zz",)]
+    before = [
+        [dict(r) for r in b] for b in mi.find_rows_many(probes)
+    ]
+    epoch0, deltas0 = mi.epoch, mi.delta_count
+    injections = {}
+    intact = True
+    for hit, label in ((0, "at_entry"), (1, "pre_swap")):
+        with faults.active(
+            FaultPlan(
+                [{"site": "storage:compact", "at": [hit], "error": "fatal"}],
+                seed=13,
+            )
+        ) as plan:
+            try:
+                mi.compact_once()
+                crashed = False
+            except InjectedFatalError:
+                crashed = True
+            injections[label] = plan.snapshot()
+        after = [
+            [dict(r) for r in b] for b in mi.find_rows_many(probes)
+        ]
+        intact = (
+            intact
+            and crashed
+            and mi.epoch == epoch0
+            and mi.delta_count == deltas0
+            and after == before
+        )
+    # disarmed retry compacts clean, bitwise-equal to the rebuild
+    stats = mi.compact_once()
+    parity = index_checksums(mi.tiers().base) == index_checksums(
+        rebuild_reference(mi)
+    )
+    answers = [
+        [dict(r) for r in b] for b in mi.find_rows_many(probes)
+    ] == before
+    return {
+        "ok": intact and stats is not None and parity and answers,
+        "tier_set_intact_after_crashes": intact,
+        "retry_compacted_deltas": None if stats is None else stats["deltas"],
+        "rebuild_parity": parity,
+        "injections": injections,
+    }
+
+
 # ---- disarmed-hook overhead gate -----------------------------------------
 
 
@@ -477,6 +549,9 @@ def main() -> int:
             )
             cases["mesh_join_under_ingest_faults"] = _with_timeout(
                 "mesh_join", lambda: case_mesh_join_under_ingest_faults(tmp_root)
+            )
+            cases["storage_compact_crash"] = _with_timeout(
+                "storage_compact_crash", case_storage_compact_crash
             )
             cases["disarmed_overhead"] = _with_timeout(
                 "disarmed_overhead", lambda: case_disarmed_overhead(idx, ids)

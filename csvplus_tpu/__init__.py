@@ -44,6 +44,7 @@ from .exprs import Rename, SetValue, Update
 from . import obs
 from . import plan
 from . import serve
+from . import storage
 from .utils import telemetry, profile_to
 
 # Go-style API aliases (reference names; BASELINE.json exercises these)
@@ -94,6 +95,7 @@ __all__ = [
     "obs",
     "plan",
     "serve",
+    "storage",
     "telemetry",
     "profile_to",
     # Go-style aliases

@@ -363,6 +363,21 @@ _WORKER_ENTRY_NAMES = (
     "on_retry",
     "on_degraded",
     "on_callback_error",
+    # csvplus_tpu/storage entry points (ISSUE 9): the mutable index's
+    # writers (append batches land from caller threads and the serve
+    # dispatcher; compact_once races both), the compactor's background
+    # loop, and the serving tier's registry/append/per-index-metrics
+    # mutators.
+    "append_rows",
+    "append_table",
+    "append_csv",
+    "compact_once",
+    "_compact_loop",
+    "run_once",
+    "register",
+    "submit_append",
+    "on_index_batch",
+    "on_compact",
 )
 
 _EAGER_TRANSFORM_OPS = frozenset(

@@ -86,6 +86,7 @@ SITES = (
     "exec:device",
     "ingest:worker",
     "ingest:read",
+    "storage:compact",
 )
 
 

@@ -33,13 +33,14 @@ architecture and env knobs, docs/RESILIENCE.md for the failure model.
 
 from ..resilience.retry import ServerCrashed
 from .admit import AdmissionController, DeadlineExceeded, ServerOverloaded
-from .coalesce import LookupServer
+from .coalesce import DEFAULT_INDEX, LookupServer
 from .metrics import BatchHistogram, LatencyReservoir, ServingMetrics
 from .plancache import PlanCache, PlanRejected, plan_cache_key
 
 __all__ = [
     "AdmissionController",
     "BatchHistogram",
+    "DEFAULT_INDEX",
     "DeadlineExceeded",
     "LatencyReservoir",
     "LookupServer",

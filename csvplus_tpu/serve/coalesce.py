@@ -1,10 +1,12 @@
 """Request coalescer: N concurrent callers, ONE batched device call.
 
-:class:`LookupServer` registers one :class:`~csvplus_tpu.index.Index`.
-Callers submit single point-lookup probes (or whole plan-IR queries)
-from any thread; a single dispatcher thread drains the pending queue
-into one ``find_rows_many`` call per cycle and scatters the per-key row
-blocks back to caller futures.  The batched engine's economics carry
+:class:`LookupServer` registers one or more named indexes (immutable
+:class:`~csvplus_tpu.index.Index` or
+:class:`~csvplus_tpu.storage.MutableIndex`).  Callers submit single
+point-lookup probes (or whole plan-IR queries, or — against a mutable
+index — append batches) from any thread; a single dispatcher thread
+drains the pending queue into one ``find_rows_many`` call per (cycle,
+index) pair and scatters the per-key row blocks back to caller futures.  The batched engine's economics carry
 over wholesale: 32 independent single-key clients ride the same
 one-searchsorted-pass / one-amortized-decode path that makes
 ``find_many`` ~6x faster per key than ``find`` — the server is how
@@ -68,25 +70,52 @@ from .plancache import PlanCache
 #: Default cap on requests per dispatch cycle (``CSVPLUS_SERVE_MAX_BATCH``).
 DEFAULT_MAX_BATCH = 4096
 
+#: Name the constructor's positional index registers under.
+DEFAULT_INDEX = "default"
+
+
+class _Registered:
+    """One named index and its per-index serving state.
+
+    ``mutable`` marks an impl exposing the storage write surface
+    (``append_rows``); only those accept :meth:`LookupServer.append`.
+    Each registration carries its own host-fallback oracle so breaker
+    degradation of one index never materializes another's rows.
+    """
+
+    __slots__ = ("name", "index", "impl", "key_width", "oracle", "mutable")
+
+    def __init__(self, name: str, index):
+        self.name = name
+        self.index = index
+        self.impl = index._impl
+        self.key_width = len(self.impl.columns)
+        self.oracle = HostLookupOracle(self.impl)
+        self.mutable = hasattr(self.impl, "append_rows")
+
 
 class ServeFuture:
     """Completion handle for one submitted request.
 
     ``result()`` returns the request's value — a ``List[Row]`` for a
     point lookup (rows cloned on delivery, same contract as
-    ``iterate``), a materialized ``DeviceTable`` for a plan query — or
-    raises the request's error (:class:`DeadlineExceeded`, a plan
-    admission rejection, or whatever the batched call raised).
+    ``iterate``), a materialized ``DeviceTable`` for a plan query, the
+    appended row count for an append batch — or raises the request's
+    error (:class:`DeadlineExceeded`, a plan admission rejection, or
+    whatever the batched call raised).
     """
 
-    __slots__ = ("probe", "plan", "deadline_s", "callback", "t_submit",
-                 "t_dispatch", "trace_ctx", "value", "error", "_event",
-                 "_done")
+    __slots__ = ("probe", "plan", "rows", "index_name", "deadline_s",
+                 "callback", "t_submit", "t_dispatch", "trace_ctx", "value",
+                 "error", "_event", "_done")
 
-    def __init__(self, probe, plan, deadline_s, callback):
+    def __init__(self, probe, plan, deadline_s, callback,
+                 index_name=DEFAULT_INDEX, rows=None):
         self._done = False
         self.probe = probe
         self.plan = plan
+        self.rows = rows
+        self.index_name = index_name
         self.deadline_s = deadline_s
         self.callback = callback
         # explicit handoff of the submitter's trace context: the
@@ -124,16 +153,33 @@ class LookupServer:
 
     def __init__(
         self,
-        index,
+        index=None,
         *,
+        indexes: Optional[dict] = None,
         max_batch: Optional[int] = None,
         max_pending: Optional[int] = None,
         tick_us: Optional[int] = None,
         plancache: Optional[PlanCache] = None,
         metrics: Optional[ServingMetrics] = None,
     ):
-        self._impl = index._impl
-        self._key_width = len(self._impl.columns)
+        # registry: the positional index lands under DEFAULT_INDEX;
+        # *indexes* (name -> Index | MutableIndex) adds named routes.
+        # Stored as an immutable-by-convention dict swapped whole under
+        # self._cv, so the dispatcher reads it with one attribute load.
+        regs: dict = {}
+        if index is not None:
+            regs[DEFAULT_INDEX] = _Registered(DEFAULT_INDEX, index)
+        for name, ix in (indexes or {}).items():
+            regs[str(name)] = _Registered(str(name), ix)
+        if not regs:
+            raise ValueError("LookupServer needs at least one index")
+        self._indexes = regs
+        default = regs.get(DEFAULT_INDEX) or regs[next(iter(regs))]
+        self._default_name = default.name
+        # back-compat aliases for the single-index surface (tests, the
+        # resilience ladder's docs): the default registration's state
+        self._impl = default.impl
+        self._key_width = default.key_width
         self.max_batch = (
             int(max_batch)
             if max_batch is not None
@@ -153,8 +199,32 @@ class LookupServer:
         # crash record that fails post-mortem submits fast
         self.retry_policy = RetryPolicy()
         self.breaker = CircuitBreaker()
-        self._oracle = HostLookupOracle(self._impl)
+        self._oracle = default.oracle
         self._crashed: Optional[ServerCrashed] = None
+
+    def register(self, name: str, index) -> None:
+        """Register (or replace) a named index while running.  The
+        registry dict is replaced whole under ``self._cv`` — in-flight
+        dispatch cycles keep the snapshot they already read."""
+        reg = _Registered(str(name), index)
+        with self._cv:
+            regs = dict(self._indexes)
+            regs[reg.name] = reg
+            self._indexes = regs
+
+    def _registered(self, name: Optional[str]) -> "_Registered":
+        regs = self._indexes
+        key = self._default_name if name is None else str(name)
+        reg = regs.get(key)
+        if reg is None:
+            raise KeyError(
+                f"no index registered as {key!r} "
+                f"(have: {', '.join(sorted(regs))})"
+            )
+        return reg
+
+    def index_names(self) -> List[str]:
+        return sorted(self._indexes)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -194,21 +264,67 @@ class LookupServer:
         *,
         deadline_s: Optional[float] = None,
         callback: Optional[Callable[[ServeFuture], None]] = None,
+        index: Optional[str] = None,
     ) -> ServeFuture:
         """Enqueue one point-lookup probe (a bare string = one-column
-        prefix, else a sequence of key values).  Returns a
+        prefix, else a sequence of key values) against the named
+        *index* (default route when omitted).  Returns a
         :class:`ServeFuture`; with *callback* set, the dispatcher thread
         invokes it on completion instead (no blocking handle).
 
         Raises :class:`~csvplus_tpu.serve.admit.ServerOverloaded` when
         the pending queue is at its bound — the request is shed, not
-        enqueued.  Probe width is validated here so a bad probe fails
-        its caller instead of poisoning a whole coalesced batch.
+        enqueued.  Probe width is validated here against the routed
+        index so a bad probe fails its caller instead of poisoning a
+        whole coalesced batch.
         """
+        reg = self._registered(index)
         norm = (probe,) if isinstance(probe, str) else tuple(probe)
-        if len(norm) > self._key_width:
+        if len(norm) > reg.key_width:
             raise ValueError("too many columns in Index.find()")
-        return self._enqueue(ServeFuture(norm, None, deadline_s, callback))
+        return self._enqueue(
+            ServeFuture(norm, None, deadline_s, callback, index_name=reg.name)
+        )
+
+    def submit_append(
+        self,
+        rows: Sequence,
+        *,
+        deadline_s: Optional[float] = None,
+        callback: Optional[Callable[[ServeFuture], None]] = None,
+        index: Optional[str] = None,
+    ) -> ServeFuture:
+        """Enqueue one append batch against a MUTABLE named index.
+
+        Appends coalesce like reads: every append for the same index
+        drained in one dispatch cycle lands as ONE delta tier (one
+        columnarize + encode + sort), and all of them are visible to
+        lookups dispatched in the same cycle.  The future's value is
+        this request's appended row count."""
+        reg = self._registered(index)
+        if not reg.mutable:
+            raise TypeError(
+                f"index {reg.name!r} is immutable (register a "
+                f"MutableIndex to accept appends)"
+            )
+        batch = [r if isinstance(r, Row) else Row(r) for r in rows]
+        if not batch:
+            raise ValueError("append batch is empty")
+        return self._enqueue(
+            ServeFuture(None, None, deadline_s, callback,
+                        index_name=reg.name, rows=batch)
+        )
+
+    def append(
+        self,
+        rows: Sequence,
+        *,
+        deadline_s: Optional[float] = None,
+        index: Optional[str] = None,
+    ) -> int:
+        """Blocking convenience: submit one append batch and wait for
+        its appended row count."""
+        return self.submit_append(rows, deadline_s=deadline_s, index=index).result()
 
     def submit_plan(
         self,
@@ -222,9 +338,14 @@ class LookupServer:
         lower) and executes the cached shape's executable."""
         return self._enqueue(ServeFuture(None, root, deadline_s, callback))
 
-    def lookup(self, *values: str, deadline_s: Optional[float] = None) -> List[Row]:
+    def lookup(
+        self,
+        *values: str,
+        deadline_s: Optional[float] = None,
+        index: Optional[str] = None,
+    ) -> List[Row]:
         """Blocking convenience: submit one probe and wait for its rows."""
-        return self.submit(values, deadline_s=deadline_s).result()
+        return self.submit(values, deadline_s=deadline_s, index=index).result()
 
     def _enqueue(self, req: ServeFuture) -> ServeFuture:
         with self._cv:
@@ -284,8 +405,10 @@ class LookupServer:
         in one lock round at the end (``on_complete_batch``)."""
         faults.inject("serve:dispatch")
         t0 = time.perf_counter()
+        regs = self._indexes  # one snapshot for the whole cycle
         samples: List[tuple] = []
-        lookups: List[ServeFuture] = []
+        lookups: dict = {}  # index name -> sub-batch
+        appends: dict = {}
         plans: List[ServeFuture] = []
         for req in batch:
             req.t_dispatch = t0
@@ -294,10 +417,16 @@ class LookupServer:
                 self._complete(req, None, expired, samples)
             elif req.plan is not None:
                 plans.append(req)
+            elif req.rows is not None:
+                appends.setdefault(req.index_name, []).append(req)
             else:
-                lookups.append(req)
-        if lookups:
-            self._run_lookups(lookups, samples)
+                lookups.setdefault(req.index_name, []).append(req)
+        # appends land BEFORE the cycle's lookups: a lookup coalesced
+        # into the same dispatch cycle as an append observes it
+        for name, reqs in appends.items():
+            self._run_appends(regs[name], reqs, samples)
+        for name, reqs in lookups.items():
+            self._run_lookups(regs[name], reqs, samples)
         for req in plans:
             # a long lookup phase, retries, or earlier plans in THIS
             # batch may have consumed a plan request's whole budget
@@ -326,12 +455,47 @@ class LookupServer:
         self.metrics.on_complete_batch(samples)
         self.metrics.observe_dispatch(len(batch), time.perf_counter() - t0)
 
-    def _run_lookups(self, lookups: List[ServeFuture], samples: List[tuple]) -> None:
-        """One coalesced batched lookup with the recovery ladder:
-        bounded deadline-aware retries on transient device failures,
-        then — retries exhausted or breaker open — the host-fallback
-        oracle (bitwise-identical results).  Non-transient failures
-        surface typed to every request in the sub-batch."""
+    def _run_appends(
+        self, reg: _Registered, reqs: List[ServeFuture], samples: List[tuple]
+    ) -> None:
+        """One coalesced append against one mutable index: every
+        request's rows concatenate into a SINGLE ``append_rows`` call —
+        one columnarize + encode + sort, one delta tier — then each
+        future completes with its own row count."""
+        rows_all: List[Row] = []
+        for req in reqs:
+            rows_all.extend(req.rows)
+        t_a = time.perf_counter()
+        try:
+            reg.impl.append_rows(rows_all)
+        except Exception as err:
+            for req in reqs:
+                self._complete(req, None, err, samples, batch_n=len(reqs))
+        else:
+            phases = (("serve:append", t_a, time.perf_counter()),)
+            for req in reqs:
+                self._complete(
+                    req, len(req.rows), None, samples,
+                    batch_n=len(reqs), phases=phases,
+                )
+        self.metrics.on_index_batch(
+            reg.name,
+            append_reqs=len(reqs),
+            rows_appended=len(rows_all),
+            deltas_live=getattr(reg.impl, "delta_count", None),
+        )
+
+    def _run_lookups(
+        self, reg: _Registered, lookups: List[ServeFuture], samples: List[tuple]
+    ) -> None:
+        """One coalesced batched lookup against one registered index,
+        with the recovery ladder: bounded deadline-aware retries on
+        transient device failures, then — retries exhausted or breaker
+        open — that index's host-fallback oracle (bitwise-identical
+        results).  Non-transient failures surface typed to every
+        request in the sub-batch.  The breaker and retry policy are
+        server-wide: a sick device path is a property of the process,
+        not of one index."""
         probes = [r.probe for r in lookups]
 
         def time_left():
@@ -351,16 +515,16 @@ class LookupServer:
             # gets both as batch-shared children of its dispatch span
             t_a = time.perf_counter()
             faults.inject("serve:bounds")
-            bounds = self._impl.bounds_many(probes)
+            bounds = reg.impl.bounds_many(probes)
             t_b = time.perf_counter()
-            groups = self._impl.rows_for_bounds(bounds)
+            groups = reg.impl.rows_for_bounds(bounds)
             return t_a, t_b, time.perf_counter(), groups
 
         def fallback_pass():
             t_a = time.perf_counter()
-            bounds = self._oracle.bounds_many(probes)
+            bounds = reg.oracle.bounds_many(probes)
             t_b = time.perf_counter()
-            groups = self._oracle.rows_for_bounds(bounds)
+            groups = reg.oracle.rows_for_bounds(bounds)
             return t_a, t_b, time.perf_counter(), groups
 
         def on_retry(attempt, err):
@@ -393,9 +557,11 @@ class LookupServer:
         except Exception as err:
             for req in lookups:
                 self._complete(req, None, err, samples, batch_n=len(lookups))
+            self.metrics.on_index_batch(reg.name, lookups=len(lookups))
             return
         if degraded:
             self.metrics.on_degraded(len(lookups))
+        self.metrics.on_index_batch(reg.name, lookups=len(lookups))
         phases = (
             ("serve:bounds", t_a, t_b),
             ("serve:gather-decode", t_b, t_c),

@@ -82,6 +82,22 @@ def _ms(seconds: Optional[float]) -> Optional[float]:
     return None if seconds is None else round(seconds * 1e3, 4)
 
 
+def _new_index_cell() -> Dict[str, object]:
+    """A fresh per-index counter cell (created under the monitor lock
+    on first touch of each index name)."""
+    return {
+        "lookups": 0,
+        "append_reqs": 0,
+        "rows_appended": 0,
+        "deltas_live": 0,
+        "compactions": 0,
+        "compacted_deltas": 0,
+        "compacted_rows": 0,
+        "compact_seconds_total": 0.0,
+        "last_compact_ms": None,
+    }
+
+
 class BatchHistogram:
     """Power-of-two histogram of dispatch batch sizes.
 
@@ -149,6 +165,9 @@ class ServingMetrics:
         self.batches = BatchHistogram()
         self.latency = LatencyReservoir(seed=reservoir_seed)
         self.queue_wait = LatencyReservoir(seed=reservoir_seed + 1)
+        # per-index split (multi-index routing + the storage write
+        # path): name -> counter cell, created on first touch
+        self._by_index: Dict[str, Dict[str, object]] = {}
 
     # -- dispatcher-side ---------------------------------------------------
 
@@ -202,6 +221,46 @@ class ServingMetrics:
                 self.latency.record(latency_s)
                 self.queue_wait.record(wait_s)
 
+    # -- per-index (multi-index routing + storage write path) --------------
+
+    def on_index_batch(
+        self,
+        name: str,
+        *,
+        lookups: int = 0,
+        append_reqs: int = 0,
+        rows_appended: int = 0,
+        deltas_live: Optional[int] = None,
+    ) -> None:
+        """One dispatch cycle's traffic against one named index — a
+        single lock round per (cycle, index) pair."""
+        with self._lock:
+            cell = self._by_index.setdefault(name, _new_index_cell())
+            cell["lookups"] += lookups
+            cell["append_reqs"] += append_reqs
+            cell["rows_appended"] += rows_appended
+            if deltas_live is not None:
+                cell["deltas_live"] = int(deltas_live)
+
+    def on_compact(
+        self,
+        name: str,
+        deltas: int,
+        rows: int,
+        seconds: float,
+        *,
+        deltas_live: int = 0,
+    ) -> None:
+        """One completed compaction pass against one named index."""
+        with self._lock:
+            cell = self._by_index.setdefault(name, _new_index_cell())
+            cell["compactions"] += 1
+            cell["compacted_deltas"] += int(deltas)
+            cell["compacted_rows"] += int(rows)
+            cell["compact_seconds_total"] += float(seconds)
+            cell["last_compact_ms"] = round(float(seconds) * 1e3, 4)
+            cell["deltas_live"] = int(deltas_live)
+
     # -- submit-side -------------------------------------------------------
 
     def on_enqueue(self) -> None:
@@ -234,6 +293,13 @@ class ServingMetrics:
                 "batch": self.batches.snapshot(),
                 "latency": self.latency.snapshot(),
                 "queue_wait": self.queue_wait.snapshot(),
+                "by_index": {
+                    name: {
+                        k: (round(v, 6) if isinstance(v, float) else v)
+                        for k, v in cell.items()
+                    }
+                    for name, cell in sorted(self._by_index.items())
+                },
             }
         if plancache is not None:
             out["plancache"] = plancache.stats()

@@ -1,0 +1,46 @@
+"""Mutable indexes: LSM delta tiers behind the immutable lookup engine.
+
+The reference csvplus ``Index`` is a frozen sorted materialization
+(csvplus.go:610-920); every layer above it in this repo — the batched
+lookup engine, the serving tier, resilience — assumed a build-once
+read-forever world.  This package opens the write workload without
+touching that machinery: appended rows land as small **sorted delta
+tiers** (each one an ordinary :class:`~csvplus_tpu.index.Index` built
+through the existing ingest + ``create_index`` encode path), lookups
+probe base+deltas through the same multi-tier ``bounds_many`` engine
+and stitch results per probe, and a background **compactor** folds
+deltas into the base with a cache-conscious multi-way merge that swaps
+in atomically under readers (epoch-snapshotted tier sets; the probe
+hot path takes no lock).
+
+* :mod:`~csvplus_tpu.storage.lsm` — :class:`DeltaTier`, :class:`TierSet`,
+  :class:`MutableIndex` (visibility rules, epoch snapshots, the
+  from-scratch rebuild reference used by the parity harness).
+* :mod:`~csvplus_tpu.storage.compact` — the stable searchsorted
+  multi-way merge over union-dictionary code spaces and the
+  :class:`Compactor` background thread.
+
+Hard contract (tests/test_storage.py + ``make bench-delta``): at every
+compaction step, base+deltas checksum-match a from-scratch rebuild of
+the same logical rows (bitwise, positional), and warm lookups against a
+compacted index record zero recompiles.  See docs/STORAGE.md.
+"""
+
+from .compact import Compactor, merge_tiers
+from .lsm import (
+    DeltaTier,
+    MutableIndex,
+    TierSet,
+    index_checksums,
+    rebuild_reference,
+)
+
+__all__ = [
+    "Compactor",
+    "DeltaTier",
+    "MutableIndex",
+    "TierSet",
+    "index_checksums",
+    "merge_tiers",
+    "rebuild_reference",
+]

@@ -1,0 +1,336 @@
+"""Tier compaction: stable multi-way merge + the background compactor.
+
+The merge is grounded in the cache-efficient sorting design of the
+Data-Parallel Graphics (DPG) line (arxiv cs/0308004): instead of a
+naive k-way heap merge (one cache-hostile pointer chase per row), every
+pass below is a **vectorized sequential sweep** over sorted arrays —
+``np.union1d`` for the dictionary unions, one translation gather per
+tier per column, and one ``np.searchsorted`` per tier pair to place
+rows.  For tier *t*'s row *i* (packed key *k*), the merged position is
+
+    pos = i + Σ_{u<t} searchsorted_right(keys_u, k)
+            + Σ_{u>t} searchsorted_left(keys_u, k)
+
+which reproduces the STABLE order of sorting the concatenated logical
+stream (older tiers win ties), so the merged index is bitwise-equal to
+a from-scratch rebuild — the parity contract the differential harness
+enforces at every compaction step.  The final materialization is one
+permuted concat per column, landed on device with a single
+``device_put`` (no jitted kernels: compaction cannot perturb the
+warm-lookup zero-recompile gate).
+
+Tiers that cannot ride the packed path (host-only tiers, typed
+``IntColumn`` columns, non-bytes dictionaries, or a >62-bit union key
+space in ``upsert`` mode) fall back to a host-row merge that is
+correct by construction (stable sort of the same logical stream).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..index import Index, IndexImpl
+from ..row import Row
+from ..utils.observe import telemetry
+from .lsm import MutableIndex, _upsert_filter, tier_rows
+
+__all__ = ["Compactor", "merge_tiers"]
+
+
+def merge_tiers(
+    tiers: Sequence[Index], key_columns: Sequence[str], mode: str = "append"
+) -> Index:
+    """Merge sorted *tiers* (oldest→newest) into one sorted Index,
+    bitwise-equal to rebuilding from the concatenated logical rows."""
+    key_columns = list(key_columns)
+    impls = [t._impl for t in tiers]
+    n_total = sum(len(i) for i in impls)
+    with telemetry.stage("storage:merge", n_total) as _t:
+        merged = _merge_device(impls, key_columns, mode)
+        _t["path"] = "device" if merged is not None else "host"
+        _t["tiers"] = len(impls)
+        if merged is None:
+            merged = _merge_host(impls, key_columns, mode)
+        _t["rows_out"] = len(merged._impl)
+    return merged
+
+
+def _translate_host(col, union: np.ndarray, n: int) -> np.ndarray:
+    """One tier column's codes in the union dictionary's code space —
+    a host translation gather over the cached code mirror (negative
+    codes pass through: -1 absent stays -1)."""
+    codes = col.codes_host()
+    if codes.shape[0] != n:
+        codes = codes[:n]
+    codes = codes.astype(np.int64)
+    d = col.dictionary
+    if d.size == 0:
+        return codes  # no real values: every code is already negative
+    trans = np.searchsorted(union, d).astype(np.int64)
+    return np.where(codes >= 0, trans[np.clip(codes, 0, d.size - 1)], codes)
+
+
+def _merge_device(impls, key_columns: List[str], mode: str) -> Optional[Index]:
+    """The packed searchsorted merge; None when any tier/column cannot
+    ride it (the caller then takes the host-row path)."""
+    import jax
+
+    from ..columnar.table import DeviceTable, StringColumn
+    from ..ops.join import DeviceIndex, _bits_for
+
+    tables = []
+    for impl in impls:
+        if impl.dev is None:
+            return None
+        tables.append(impl.dev.table)
+    for t in tables:
+        for c in t.columns.values():
+            if not isinstance(c, StringColumn):
+                return None  # typed columns merge on the host path
+    names: List[str] = []
+    seen = set()
+    for t in tables:
+        for n in t.columns:
+            if n not in seen:
+                seen.add(n)
+                names.append(n)
+    n_rows = [t.nrows for t in tables]
+    n_tiers = len(tables)
+
+    # union dictionary per column: one sorted-union pass each.  (A
+    # device-lane dictionary settles to its host form here; keeping the
+    # merge lane-native is the open half of ROADMAP item 5's note.)
+    unions: Dict[str, np.ndarray] = {}
+    for name in names:
+        dicts = [
+            np.asarray(t.columns[name].dictionary)
+            for t in tables
+            if name in t.columns
+        ]
+        if any(d.dtype.kind != "S" for d in dicts):
+            return None  # non-bytes dictionary: host path
+        u = dicts[0]
+        for d in dicts[1:]:
+            u = np.union1d(u, d)
+        unions[name] = u
+
+    key_unions = [unions[c] for c in key_columns]
+    bits = [_bits_for(u.size) for u in key_unions]
+    packed: Optional[List[np.ndarray]] = None
+    if sum(bits) <= 62:
+        shifts: List[int] = []
+        acc = 0
+        for b in reversed(bits):
+            shifts.insert(0, acc)
+            acc += b
+        packed = []
+        for t in range(n_tiers):
+            k = np.zeros(n_rows[t], dtype=np.int64)
+            for c, u, sh in zip(key_columns, key_unions, shifts):
+                # key cells are never absent (create_index validated),
+                # so the translated codes are all >= 0 and pack cleanly
+                k |= _translate_host(tables[t].columns[c], u, n_rows[t]) << sh
+            packed.append(k)
+    elif mode == "upsert":
+        return None  # per-key shadowing needs the packed comparison
+
+    keep: Optional[List[np.ndarray]] = None
+    if mode == "upsert":
+        # newest-wins: drop tier t's row when its key appears in ANY
+        # newer tier — two searchsorted sweeps per (t, newer) pair
+        keep = [np.ones(n_rows[t], dtype=bool) for t in range(n_tiers)]
+        for t in range(n_tiers):
+            for u_t in range(t + 1, n_tiers):
+                lo = np.searchsorted(packed[u_t], packed[t], side="left")
+                hi = np.searchsorted(packed[u_t], packed[t], side="right")
+                keep[t] &= hi == lo
+
+    if packed is not None:
+        kept = [
+            packed[t][keep[t]] if keep is not None else packed[t]
+            for t in range(n_tiers)
+        ]
+        total = sum(k.size for k in kept)
+        g = np.empty(total, dtype=np.int64)
+        off = 0
+        for t in range(n_tiers):
+            pos = np.arange(kept[t].size, dtype=np.int64)
+            for u_t in range(n_tiers):
+                if u_t == t:
+                    continue
+                side = "right" if u_t < t else "left"
+                pos += np.searchsorted(kept[u_t], kept[t], side=side)
+            if keep is not None:
+                src = np.flatnonzero(keep[t]).astype(np.int64) + off
+            else:
+                src = np.arange(n_rows[t], dtype=np.int64) + off
+            g[pos] = src
+            off += n_rows[t]
+    else:
+        # >62-bit union key space: stable lexsort over the translated
+        # key-code matrix — same order, no packing
+        cat_keys = [
+            np.concatenate(
+                [
+                    _translate_host(tables[t].columns[c], u, n_rows[t])
+                    for t in range(n_tiers)
+                ]
+            )
+            for c, u in zip(key_columns, key_unions)
+        ]
+        g = np.lexsort(tuple(reversed(cat_keys)))
+        total = int(g.size)
+
+    if total == 0:
+        # mirror create_index: an empty result is a host-backed empty
+        # index (no device build over zero rows)
+        return Index(IndexImpl([], key_columns))
+
+    device = tables[0].device
+    cols: Dict[str, StringColumn] = {}
+    for name in names:
+        u = unions[name]
+        parts = []
+        for t in range(n_tiers):
+            col = tables[t].columns.get(name)
+            if col is None:
+                parts.append(np.full(n_rows[t], -1, dtype=np.int32))
+            else:
+                parts.append(
+                    _translate_host(col, u, n_rows[t]).astype(np.int32)
+                )
+        cat = np.concatenate(parts)
+        cols[name] = StringColumn(u, jax.device_put(cat[g], device))
+    out_table = DeviceTable(cols, int(total), device)
+    dev = DeviceIndex.build(out_table, key_columns)
+    return Index(IndexImpl(None, key_columns, dev=dev))
+
+
+def _merge_host(impls, key_columns: List[str], mode: str) -> Index:
+    """Correct-by-construction fallback: stable host sort over the
+    cloned logical row stream (create_index's own ordering)."""
+    streams = [tier_rows(i) for i in impls]
+    if mode == "upsert":
+        streams = _upsert_filter(streams, key_columns)
+    rows = [Row(r) for s in streams for r in s]
+    rows.sort(key=lambda r: tuple(r[c] for c in key_columns))  # stable
+    return Index(IndexImpl(rows, key_columns))
+
+
+class Compactor:
+    """Background compaction thread over one :class:`MutableIndex`.
+
+    ``_compact_loop`` is a THREAD001 worker entry: all Compactor state
+    mutates under ``self._lock``; the index's own swap discipline lives
+    in :meth:`MutableIndex.compact_once`.  A failed pass (including an
+    injected ``storage:compact`` fault) leaves the tier set untouched
+    and is retried on the next interval — compaction is idempotent
+    from any crash point before the swap.
+    """
+
+    def __init__(
+        self,
+        index: MutableIndex,
+        *,
+        min_deltas: int = 1,
+        interval_s: float = 0.02,
+        metrics=None,
+        index_name: str = "default",
+    ):
+        if min_deltas < 1:
+            raise ValueError("min_deltas must be >= 1")
+        self.index = index
+        self.min_deltas = int(min_deltas)
+        self.interval_s = float(interval_s)
+        self._metrics = metrics
+        self._name = index_name
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.compactions = 0
+        self.failures = 0
+        self.last_error: Optional[BaseException] = None
+        self.last_stats: Optional[Dict[str, object]] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Compactor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        t = threading.Thread(
+            target=self._compact_loop, name="csvplus-storage-compact", daemon=True
+        )
+        self._thread = t
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self) -> "Compactor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- one pass ----------------------------------------------------------
+
+    def run_once(self) -> Optional[Dict[str, object]]:
+        """One compaction pass (also the unit tests' direct entry).
+        Exceptions propagate to the caller; the loop catches them."""
+        stats = self.index.compact_once()
+        if stats is not None:
+            with self._lock:
+                self.compactions += 1
+                self.last_stats = stats
+            m = self._metrics
+            if m is not None:
+                m.on_compact(
+                    self._name,
+                    int(stats["deltas"]),
+                    int(stats["rows_out"]),
+                    float(stats["seconds"]),
+                    deltas_live=self.index.delta_count,
+                )
+        return stats
+
+    def _compact_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.index.delta_count >= self.min_deltas:
+                    self.run_once()
+            except Exception as err:
+                # retryable by design: every crash point before the
+                # swap leaves the pre-compaction tier set live, so the
+                # next interval simply tries again — record and report
+                with self._lock:
+                    self.failures += 1
+                    self.last_error = err
+                sys.stderr.write(
+                    f"csvplus-storage: compaction pass failed "
+                    f"({type(err).__name__}: {err}); tier set unchanged, "
+                    f"retrying next interval\n"
+                )
+            self._stop.wait(self.interval_s)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "compactions": self.compactions,
+                "failures": self.failures,
+                "last_error": (
+                    None
+                    if self.last_error is None
+                    else f"{type(self.last_error).__name__}: {self.last_error}"
+                ),
+                "last_stats": dict(self.last_stats) if self.last_stats else None,
+            }

@@ -1,0 +1,492 @@
+"""LSM tier sets: delta tiers, epoch snapshots, multi-tier lookups.
+
+Layout
+------
+
+A :class:`MutableIndex` is a **base** tier (an ordinary sorted
+:class:`~csvplus_tpu.index.Index`) plus a tuple of **delta** tiers,
+each itself a small sorted Index built from one append batch through
+the existing encode path (``DeviceTable`` columnarization or the
+staged streamed-ingest pipeline for ``append_csv``).  The logical row
+stream is the concatenation base → delta0 → delta1 → … in append
+order; every read answers as if that stream had been indexed from
+scratch.
+
+Visibility (``mode``)
+---------------------
+
+* ``"append"`` (default) — multiset appends: all tiers are visible,
+  equal keys interleave in (key, tier, within-tier position) order —
+  bitwise-identical to a from-scratch **stable** rebuild of the
+  logical stream, because each tier is itself a stable sort of its
+  batch.
+* ``"upsert"`` — newest-wins: a key present in a newer tier shadows
+  every older tier's rows for that key (whole key groups, so one
+  append batch may still hold duplicates).  Equal to rebuilding after
+  dropping each row whose full key reappears in any LATER tier.
+
+Concurrency (the r10 epoch rule)
+--------------------------------
+
+All tier-list state lives in one immutable :class:`TierSet`; readers
+pin it with a single attribute read (``self._tiers`` — atomic under
+the GIL) and never take a lock on the probe hot path.  Writers
+(``append_*`` / ``compact_once``) build a NEW TierSet and swap it
+under ``self._lock``.  The compactor merges OUTSIDE the lock against
+its pinned snapshot and swaps only the merged prefix, so appends
+landing mid-merge survive as the new tier list's tail.  ``append_rows``
+and ``compact_once`` are THREAD001 worker entries
+(analysis/astlint.py): every shared-state mutation below them must sit
+under a lock, with zero allowances.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..index import Index, create_index
+from ..resilience import faults
+from ..row import Row
+from ..source import take_rows
+from ..utils.observe import telemetry
+
+__all__ = [
+    "DeltaTier",
+    "MutableIndex",
+    "TierSet",
+    "index_checksums",
+    "rebuild_reference",
+    "tier_rows",
+]
+
+_MODES = ("append", "upsert")
+
+
+class DeltaTier:
+    """One append batch, materialized as a small sorted Index."""
+
+    __slots__ = ("seq", "index")
+
+    def __init__(self, seq: int, index: Index):
+        self.seq = seq
+        self.index = index
+
+    @property
+    def nrows(self) -> int:
+        return len(self.index._impl)
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"DeltaTier(seq={self.seq}, nrows={self.nrows})"
+
+
+class TierSet:
+    """Immutable snapshot of the tier list at one epoch.
+
+    Readers that captured a TierSet keep answering from it even while
+    a writer swaps in a successor — the old tiers stay alive (and
+    correct) for as long as any reader holds them.
+    """
+
+    __slots__ = ("epoch", "base", "deltas")
+
+    def __init__(self, epoch: int, base: Index, deltas: Tuple[DeltaTier, ...]):
+        self.epoch = epoch
+        self.base = base
+        self.deltas = deltas
+
+    def indexes(self) -> Tuple[Index, ...]:
+        """All tiers oldest→newest (base first)."""
+        return (self.base,) + tuple(d.index for d in self.deltas)
+
+
+class MultiBounds:
+    """Pinned tier set + per-tier bounds for one probe batch.
+
+    Opaque handle between :meth:`MutableIndex.bounds_many` and
+    :meth:`MutableIndex.rows_for_bounds` — pinning the TierSet here
+    keeps the two phases epoch-consistent even when the compactor
+    swaps between them (the serving tier calls them separately).
+    """
+
+    __slots__ = ("tiers", "per_tier", "probes")
+
+    def __init__(self, tiers: TierSet, per_tier, probes):
+        self.tiers = tiers
+        self.per_tier = per_tier
+        self.probes = probes
+
+
+def tier_rows(impl) -> List[Row]:
+    """Decode one tier's sorted rows WITHOUT flipping a device-lazy
+    impl onto its host branch: touching ``impl.rows`` would cache host
+    rows and permanently reroute ``bounds_many`` off the device path
+    (the same trap HostLookupOracle documents)."""
+    if impl._rows is None and impl.dev is not None:
+        return impl.dev.table.to_rows()
+    return impl.rows
+
+
+def _logical_streams(ts: TierSet) -> List[List[Row]]:
+    return [tier_rows(ix._impl) for ix in ts.indexes()]
+
+
+def _upsert_filter(streams: List[List[Row]], key_cols: Sequence[str]) -> List[List[Row]]:
+    """Drop every row whose full key appears in any LATER tier — the
+    newest-wins rebuild rule, computed key-by-key on host rows
+    (deliberately independent of the packed-key merge in compact.py so
+    the parity harness cross-checks two implementations)."""
+    newest: Dict[tuple, int] = {}
+    for t, rows in enumerate(streams):
+        for r in rows:
+            newest[tuple(r[c] for c in key_cols)] = t
+    return [
+        [r for r in rows if newest[tuple(r[c] for c in key_cols)] == t]
+        for t, rows in enumerate(streams)
+    ]
+
+
+def rebuild_reference(mindex: "MutableIndex", ts: Optional[TierSet] = None) -> Index:
+    """From-scratch rebuild of the pinned tier set's logical rows —
+    the parity harness's ground truth.  Routes through the HOST
+    ``create_index`` build (stable Python sort over Row dicts), a
+    completely separate code path from the compactor's packed
+    searchsorted merge, so agreement is meaningful."""
+    ts = ts if ts is not None else mindex.tiers()
+    streams = _logical_streams(ts)
+    if mindex.mode == "upsert":
+        streams = _upsert_filter(streams, mindex.columns)
+    rows = [Row(r) for s in streams for r in s]
+    return create_index(take_rows(rows), mindex.columns)
+
+
+def index_checksums(index: Index, columns: Optional[Sequence[str]] = None) -> Dict[str, int]:
+    """Positional per-column checksums over an index's sorted rows —
+    the differential-harness currency (utils/checksum.py), order-
+    sensitive so tier-merge bugs that permute equal keys still trip."""
+    from ..utils.checksum import checksum_host_rows
+
+    rows = tier_rows(index._impl)
+    if columns is None:
+        seen = set()
+        columns = []
+        for r in rows:
+            for c in r:
+                if c not in seen:
+                    seen.add(c)
+                    columns.append(c)
+        columns = sorted(columns)
+    return checksum_host_rows(rows, columns, positional=True)
+
+
+class MutableIndex:
+    """LSM-style mutable index over the immutable lookup engine.
+
+    Implements the lookup-impl protocol the serving tier consumes
+    (``columns`` / ``bounds_many`` / ``rows_for_bounds`` /
+    ``find_rows_many``) plus the write surface (``append_rows`` /
+    ``append_table`` / ``append_csv`` / ``compact_once``), so a
+    ``LookupServer`` can register one directly.
+    """
+
+    # lookup-protocol compatibility: the host-fallback oracle checks
+    # ``impl.dev`` to decide whether it may reuse the impl directly —
+    # a MutableIndex IS its own host-correct fallback
+    dev = None
+
+    def __init__(self, base: Index, *, mode: str = "append", ingest_device=None):
+        if not isinstance(base, Index):
+            raise TypeError("MutableIndex wraps an existing Index as its base tier")
+        if mode not in _MODES:
+            raise ValueError(f"unknown MutableIndex mode {mode!r} (use append|upsert)")
+        self.mode = mode
+        self._columns = list(base._impl.columns)
+        impl = base._impl
+        self._device = (
+            impl.dev.table.device if impl.dev is not None else ingest_device
+        )
+        self._ingest_device = ingest_device
+        self._lock = threading.Lock()
+        # serializes whole compaction passes (snapshot -> merge -> swap):
+        # the swap-prefix invariant assumes at most one in-flight merge
+        self._compact_lock = threading.Lock()
+        self._tiers = TierSet(0, base, ())
+        self._next_seq = 1
+        self._compactions = 0
+        self._compact_seconds = 0.0
+
+    @classmethod
+    def create(cls, src, columns: Sequence[str], *, mode: str = "append", ingest_device=None) -> "MutableIndex":
+        """Build the base tier with ``create_index`` and wrap it."""
+        return cls(create_index(src, columns), mode=mode, ingest_device=ingest_device)
+
+    # -- lookup-impl protocol ----------------------------------------------
+
+    @property
+    def _impl(self) -> "MutableIndex":
+        # LookupServer unwraps ``index._impl``; a MutableIndex is its
+        # own impl (bounds_many/rows_for_bounds below span all tiers)
+        return self
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._columns)
+
+    @property
+    def epoch(self) -> int:
+        return self._tiers.epoch
+
+    @property
+    def delta_count(self) -> int:
+        return len(self._tiers.deltas)
+
+    def tiers(self) -> TierSet:
+        """Pin the current tier-set epoch (one atomic read)."""
+        return self._tiers
+
+    def __len__(self) -> int:
+        ts = self._tiers
+        return sum(len(ix._impl) for ix in ts.indexes())
+
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-safe accounting for metrics/bench artifacts."""
+        ts = self._tiers
+        with self._lock:
+            compactions = self._compactions
+            compact_s = self._compact_seconds
+        return {
+            "mode": self.mode,
+            "epoch": ts.epoch,
+            "base_rows": len(ts.base._impl),
+            "deltas": len(ts.deltas),
+            "delta_rows": sum(d.nrows for d in ts.deltas),
+            "compactions": compactions,
+            "compact_seconds_total": round(compact_s, 6),
+        }
+
+    # -- reads (no lock on this path) --------------------------------------
+
+    def bounds_many(self, probes: Sequence[Sequence[str]]) -> MultiBounds:
+        """Per-tier bounds for the whole probe batch: one vectorized
+        ``bounds_many`` pass per tier (the existing multi-tier
+        ``point_bounds_many`` machinery), pinned to one epoch."""
+        norm = [(p,) if isinstance(p, str) else tuple(p) for p in probes]
+        width = len(self._columns)
+        for p in norm:
+            if len(p) > width:
+                raise ValueError("too many columns in Index.find()")
+        ts = self._tiers
+        per_tier = [ix._impl.bounds_many(norm) for ix in ts.indexes()]
+        return MultiBounds(ts, per_tier, norm)
+
+    def rows_for_bounds(self, mb: MultiBounds) -> List[List[Row]]:
+        """Merge per-tier bounds into per-probe row blocks with ONE
+        amortized gather-decode per tier (each tier's matched ranges
+        decode together through its ``rows_for_bounds``).
+
+        Fast paths: a probe matched by a single tier returns that
+        tier's block directly; a full-width probe needs no key-level
+        merge (all rows share one key — ``append`` concatenates in
+        tier order, ``upsert`` decodes only the newest matching tier).
+        Only multi-tier PREFIX probes pay the host key-merge."""
+        tiers = mb.tiers.indexes()
+        per_tier = mb.per_tier
+        n_tiers = len(tiers)
+        n_probes = len(mb.probes)
+        width = len(self._columns)
+        upsert = self.mode == "upsert"
+        eff: List[List[Tuple[int, int]]] = [
+            [(0, 0)] * n_probes for _ in range(n_tiers)
+        ]
+        plan: List[Tuple[str, Tuple[int, ...]]] = [("none", ())] * n_probes
+        for i in range(n_probes):
+            live = [
+                t for t in range(n_tiers) if per_tier[t][i][1] > per_tier[t][i][0]
+            ]
+            if not live:
+                continue
+            if len(live) == 1 or (upsert and len(mb.probes[i]) == width):
+                t = live[-1] if upsert else live[0]
+                # single visible tier (or newest-wins point probe):
+                # decode exactly one tier's range, shadowed rows never
+                # leave the device/mirror
+                eff[t][i] = per_tier[t][i]
+                plan[i] = ("one", (t,))
+            else:
+                for t in live:
+                    eff[t][i] = per_tier[t][i]
+                kind = "concat" if len(mb.probes[i]) == width else "merge"
+                plan[i] = (kind, tuple(live))
+        decoded: List[Optional[List[List[Row]]]] = [None] * n_tiers
+        for t in range(n_tiers):
+            if any(hi > lo for lo, hi in eff[t]):
+                decoded[t] = tiers[t]._impl.rows_for_bounds(eff[t])
+        out: List[List[Row]] = []
+        for i in range(n_probes):
+            kind, live = plan[i]
+            if kind == "none":
+                out.append([])
+            elif kind == "one":
+                out.append(decoded[live[0]][i])
+            elif kind == "concat":
+                # full-width probe: every matched row carries the same
+                # key, so tier order IS the rebuild's stable order
+                rows: List[Row] = []
+                for t in live:
+                    rows.extend(decoded[t][i])
+                out.append(rows)
+            else:
+                out.append(
+                    _merge_blocks(
+                        [(t, decoded[t][i]) for t in live],
+                        self._columns,
+                        upsert,
+                    )
+                )
+        return out
+
+    def find_rows_many(self, probes: Sequence[Sequence[str]]) -> List[List[Row]]:
+        return self.rows_for_bounds(self.bounds_many(probes))
+
+    def find_rows(self, values: Sequence[str]) -> List[Row]:
+        return self.find_rows_many([values])[0]
+
+    def has(self, values: Sequence[str]) -> bool:
+        return bool(self.find_rows_many([values])[0])
+
+    # -- writes (THREAD001 entries) ----------------------------------------
+
+    def append_rows(self, rows: Sequence) -> int:
+        """Append a batch of rows as one new delta tier.
+
+        The batch columnarizes through ``DeviceTable.from_rows`` and
+        the device ``create_index`` build — the same per-tier encode
+        path every index rides — then lands as a sorted delta."""
+        rows = [r if isinstance(r, Row) else Row(r) for r in rows]
+        if not rows:
+            return 0
+        from ..columnar.ingest import source_from_table
+        from ..columnar.table import DeviceTable
+
+        table = DeviceTable.from_rows(rows, device=self._device)
+        idx = create_index(source_from_table(table), self._columns)
+        self._push_delta(idx)
+        return len(rows)
+
+    def append_table(self, table) -> int:
+        """Append an already-columnarized DeviceTable as one delta."""
+        from ..columnar.ingest import source_from_table
+
+        if table.nrows == 0:
+            return 0
+        idx = create_index(source_from_table(table), self._columns)
+        self._push_delta(idx)
+        return table.nrows
+
+    def append_csv(self, path: str, *, device: Optional[str] = None, shards=None) -> int:
+        """Append a CSV file through the staged streamed-ingest
+        pipeline (``columnar/ingest.py`` tiers, K workers via
+        ``CSVPLUS_INGEST_WORKERS``) — bitwise-identical deltas
+        regardless of worker count, per the standing ingest contract."""
+        from ..reader import from_file
+
+        src = from_file(path).on_device(
+            device if device is not None else (self._ingest_device or "cpu"),
+            shards=shards,
+        )
+        idx = create_index(src, self._columns)
+        n = len(idx._impl)
+        if n == 0:
+            return 0
+        self._push_delta(idx)
+        return n
+
+    def _push_delta(self, idx: Index) -> None:
+        with self._lock:
+            ts = self._tiers
+            delta = DeltaTier(self._next_seq, idx)
+            self._next_seq += 1
+            self._tiers = TierSet(ts.epoch + 1, ts.base, ts.deltas + (delta,))
+
+    def compact_once(self) -> Optional[Dict[str, object]]:
+        """Merge the current deltas into the base and swap the merged
+        tier set in atomically.  Returns merge stats, or None when
+        there was nothing to compact.
+
+        Crash safety: the fault-injection site ``storage:compact``
+        fires once on entry and once just before the swap; an
+        exception at either point (or anywhere in the merge) leaves
+        ``self._tiers`` untouched — the pre-compaction tier set stays
+        live and a retry starts clean.  Appends racing the merge are
+        preserved: only the pinned snapshot's deltas are folded in,
+        newer deltas carry over as the new tail."""
+        faults.inject("storage:compact")
+        with self._compact_lock:
+            ts = self._tiers
+            if not ts.deltas:
+                return None
+            from .compact import merge_tiers
+
+            n_in = sum(len(ix._impl) for ix in ts.indexes())
+            t0 = time.perf_counter()
+            with telemetry.stage("storage:compact", n_in) as _t:
+                merged = merge_tiers(list(ts.indexes()), self._columns, self.mode)
+                _t["deltas"] = len(ts.deltas)
+                # the pre-swap crash window: a compactor death AFTER the
+                # merge but BEFORE the swap must also leave the old tier
+                # set intact (chaos scenario `storage_compact_crash`)
+                faults.inject("storage:compact")
+                seconds = time.perf_counter() - t0
+                with self._lock:
+                    cur = self._tiers
+                    self._tiers = TierSet(
+                        cur.epoch + 1, merged, cur.deltas[len(ts.deltas):]
+                    )
+                    self._compactions += 1
+                    self._compact_seconds += seconds
+                _t["rows_out"] = len(merged._impl)
+            return {
+                "deltas": len(ts.deltas),
+                "rows_in": n_in,
+                "rows_out": len(merged._impl),
+                "seconds": seconds,
+                "epoch": self._tiers.epoch,
+            }
+
+    def to_index(self) -> Index:
+        """A frozen Index equal to fully compacting the CURRENT tier
+        set, without swapping it in (the concurrent-read tests' frozen
+        equivalent)."""
+        from .compact import merge_tiers
+
+        ts = self._tiers
+        if not ts.deltas:
+            return ts.base
+        return merge_tiers(list(ts.indexes()), self._columns, self.mode)
+
+
+def _merge_blocks(
+    tagged: List[Tuple[int, List[Row]]], key_cols: Sequence[str], upsert: bool
+) -> List[Row]:
+    """Key-level merge of per-tier row blocks for one PREFIX probe.
+
+    Each block is sorted by full key (it came out of a sorted tier);
+    the rebuild's order for the union is (key, tier, within-tier
+    position), which a stable sort by key alone reproduces because the
+    input list is built tier-by-tier in position order."""
+    if upsert:
+        newest: Dict[tuple, int] = {}
+        for t, rows in tagged:
+            for r in rows:
+                newest[tuple(r[c] for c in key_cols)] = t
+        tagged = [
+            (t, [r for r in rows if newest[tuple(r[c] for c in key_cols)] == t])
+            for t, rows in tagged
+        ]
+    items: List[Tuple[tuple, Row]] = []
+    for t, rows in tagged:
+        for r in rows:
+            items.append((tuple(r[c] for c in key_cols), r))
+    items.sort(key=lambda it: it[0])  # stable: ties keep (tier, pos) order
+    return [r for _, r in items]

@@ -477,3 +477,63 @@ def test_host_oracle_leaves_primary_device_path_intact(served):
     # the fallback build must NOT have materialized the primary impl's
     # host rows — that would permanently flip it off the device path
     assert impl._rows is None
+
+
+# -- storage: compactor crash leaves the tier set intact (ISSUE 9) ---------
+
+
+def test_storage_compact_crash_served_reads_unaffected(served):
+    """A compactor death mid-pass (the ``storage:compact`` site) under
+    a SERVED mutable index: lookups keep answering from the pinned
+    pre-compaction tier set, the tier set stays intact and retryable,
+    and the disarmed retry compacts to full rebuild parity."""
+    from csvplus_tpu.row import Row
+    from csvplus_tpu.source import take_rows
+    from csvplus_tpu.storage import (
+        Compactor,
+        MutableIndex,
+        index_checksums,
+        rebuild_reference,
+    )
+
+    idx, ids = served
+    mi = MutableIndex.create(
+        take_rows([Row({"k": f"k{i % 23:03d}", "v": f"v{i}"}) for i in range(300)]),
+        ["k"],
+        ingest_device="cpu",
+    )
+    mi.append_rows([{"k": f"n{j}", "v": "x"} for j in range(10)])
+    epoch0, deltas0 = mi.epoch, mi.delta_count
+    with LookupServer(idx, indexes={"mut": mi}) as srv:
+        serial = [
+            [dict(r) for r in srv.lookup(p, index="mut")]
+            for p in ("k001", "n3", "zz")
+        ]
+        c = Compactor(mi, min_deltas=1, interval_s=0.002)
+        with faults.active(
+            FaultPlan(
+                [{"site": "storage:compact", "at": [0], "error": "fatal"}],
+                seed=7,
+            )
+        ) as plan:
+            with c:
+                deadline = 400
+                while mi.delta_count and deadline:
+                    deadline -= 1
+                    time.sleep(0.005)
+                # reads during the crash/retry window stay correct
+                got = [
+                    [dict(r) for r in srv.lookup(p, index="mut")]
+                    for p in ("k001", "n3", "zz")
+                ]
+        assert got == serial
+        assert plan.snapshot()["fired"]["storage:compact"] == 1
+    snap = c.snapshot()
+    assert snap["failures"] >= 1 and "InjectedFatalError" in snap["last_error"]
+    # the crash left the set retryable; the loop's retry then compacted
+    assert snap["compactions"] >= 1
+    assert mi.delta_count == 0
+    assert mi.epoch > epoch0 and deltas0 == 1
+    assert index_checksums(mi.tiers().base) == index_checksums(
+        rebuild_reference(mi)
+    )

@@ -569,3 +569,85 @@ def test_sharded_ingest_worker_count_unobservable(tmp_path, monkeypatch):
         sums[k] = checksum_device_table(table, cols, positional=True)
         assert run_either(src, []) == host, f"workers={k}"
     assert sums["2"] == sums["1"] and sums["8"] == sums["1"], sums
+
+
+def _tricky_csv(tmp_path, name, n, off=0, demote_at=None):
+    """CSV with quoted comma/CRLF carry-over cuts and an optionally
+    demoting typed lane — the shapes that catch chunk-boundary and
+    per-shard-seal bugs in the staged pipeline."""
+    rows = []
+    for i in range(off, off + n):
+        if i % 5 == 0:
+            rows.append(f'o{i},"q,{i}\r\nx",{i}')
+        else:
+            rows.append(f"o{i},w{i % 3},{i}")
+    if demote_at is not None:
+        rows[demote_at] = f"o{off + demote_at},plain,notanint"
+    p = tmp_path / name
+    p.write_bytes(("a,b,c\r\n" + "\r\n".join(rows) + "\r\n").encode())
+    return str(p)
+
+
+def test_storage_append_csv_worker_count_unobservable(tmp_path, monkeypatch):
+    """ISSUE 9 acceptance: a MutableIndex built and appended through
+    the K-worker streamed-ingest pipeline must be bitwise-identical —
+    live tier set AND post-compaction base both checksum-match the
+    from-scratch rebuild — for CSVPLUS_INGEST_WORKERS in {1, 2, 8}."""
+    from csvplus_tpu import from_file
+    from csvplus_tpu.storage import (
+        MutableIndex,
+        index_checksums,
+        rebuild_reference,
+    )
+
+    monkeypatch.setenv("CSVPLUS_STREAM_MIN_BYTES", "1")
+    monkeypatch.setenv("CSVPLUS_STREAM_CHUNK_BYTES", "96")
+    base = _tricky_csv(tmp_path, "base.csv", 120, demote_at=100)
+    d1 = _tricky_csv(tmp_path, "d1.csv", 40, off=200)
+    d2 = _tricky_csv(tmp_path, "d2.csv", 30, off=300, demote_at=10)
+
+    live_sums, compact_sums = {}, {}
+    for k in ("1", "2", "8"):
+        monkeypatch.setenv("CSVPLUS_INGEST_WORKERS", k)
+        mi = MutableIndex.create(from_file(base).on_device("cpu"), ["a"])
+        assert mi.append_csv(d1) == 40
+        assert mi.append_csv(d2) == 30
+        ref = index_checksums(rebuild_reference(mi))
+        live_sums[k] = index_checksums(mi.to_index())
+        assert live_sums[k] == ref, f"workers={k} live"
+        assert mi.compact_once() is not None
+        compact_sums[k] = index_checksums(mi.tiers().base)
+        assert compact_sums[k] == ref, f"workers={k} compacted"
+    assert live_sums["2"] == live_sums["1"] == live_sums["8"]
+    assert compact_sums["2"] == compact_sums["1"] == compact_sums["8"]
+
+
+def test_storage_mesh_sharded_append_parity(tmp_path, monkeypatch):
+    """The same contract on the 8-shard MESH placement: base and delta
+    tiers both ingest sharded (chunk boundaries mid-file, per-shard
+    typed seal), and every compaction step checksum-matches the host
+    rebuild of the logical append stream."""
+    from csvplus_tpu import from_file
+    from csvplus_tpu.storage import (
+        MutableIndex,
+        index_checksums,
+        rebuild_reference,
+    )
+
+    _needs_mesh()
+    monkeypatch.setenv("CSVPLUS_STREAM_MIN_BYTES", "1")
+    monkeypatch.setenv("CSVPLUS_STREAM_CHUNK_BYTES", "96")
+    base = _tricky_csv(tmp_path, "base.csv", 120, demote_at=100)
+    d1 = _tricky_csv(tmp_path, "d1.csv", 40, off=200)
+
+    mi = MutableIndex.create(
+        from_file(base).on_device("cpu", shards=8), ["a"]
+    )
+    assert mi.append_csv(d1, shards=8) == 40
+    ref = index_checksums(rebuild_reference(mi))
+    assert index_checksums(mi.to_index()) == ref
+    assert mi.compact_once() is not None
+    assert index_checksums(mi.tiers().base) == ref
+    # probes answer identically post-compaction
+    assert [r["c"] for r in mi.find_rows("o210")] == ["210"]
+    assert mi.find_rows("o999") == []

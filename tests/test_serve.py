@@ -364,3 +364,132 @@ def test_bounds_many_threaded_equal_serial(served):
         t.join()
     for got in out:
         assert np.array_equal(np.asarray(got), np.asarray(serial))
+
+
+# ---------------------------------------------------------------------------
+# multi-index routing + the storage write path (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+def _mutable(n=200):
+    from csvplus_tpu.row import Row
+    from csvplus_tpu.source import take_rows
+    from csvplus_tpu.storage import MutableIndex
+
+    rows = [Row({"k": f"k{i % 17:03d}", "v": f"v{i}"}) for i in range(n)]
+    return MutableIndex.create(take_rows(rows), ["k"], ingest_device="cpu")
+
+
+def test_multi_index_routing_and_per_index_metrics(served):
+    idx, ids = served
+    mi = _mutable()
+    with LookupServer(idx, indexes={"mut": mi}) as srv:
+        assert srv.index_names() == ["default", "mut"]
+        # each route answers from ITS index (different schemas)
+        assert srv.lookup("c7")[0]["v"] == "1"
+        assert srv.lookup("k001", index="mut")[0]["k"] == "k001"
+        # probe width validates against the routed index
+        with pytest.raises(ValueError, match="too many columns"):
+            srv.submit(("a", "b", "c"), index="mut")
+        with pytest.raises(KeyError, match="no index registered"):
+            srv.lookup("c7", index="nope")
+        # live registration
+        srv.register("second", idx)
+        assert srv.lookup("c7", index="second")[0]["v"] == "1"
+        snap = srv.snapshot()
+    by = snap["by_index"]
+    assert by["default"]["lookups"] >= 1
+    assert by["mut"]["lookups"] >= 1
+    assert by["second"]["lookups"] >= 1
+
+
+def test_serve_append_coalesces_and_is_visible(served):
+    idx, ids = served
+    mi = _mutable()
+    with LookupServer(idx, indexes={"mut": mi}) as srv:
+        # immutable index rejects appends, typed
+        with pytest.raises(TypeError, match="immutable"):
+            srv.append([{"id": "x", "v": "y"}])
+        with pytest.raises(ValueError, match="empty"):
+            srv.submit_append([], index="mut")
+        epoch0 = mi.epoch
+        futs = [
+            srv.submit_append([{"k": f"srv{j}", "v": str(j)}], index="mut")
+            for j in range(6)
+        ]
+        assert [f.result(timeout=30.0) for f in futs] == [1] * 6
+        for j in range(6):
+            got = srv.lookup(f"srv{j}", index="mut")
+            assert [r["v"] for r in got] == [str(j)]
+        # coalescing: 6 append requests landed in <= 6 delta tiers and
+        # at most (epoch swaps == delta pushes) — each dispatch cycle
+        # folded its drained appends into ONE tier
+        assert mi.epoch - epoch0 == mi.delta_count
+        assert mi.delta_count <= 6
+        snap = srv.snapshot()
+    cell = snap["by_index"]["mut"]
+    assert cell["append_reqs"] == 6
+    assert cell["rows_appended"] == 6
+    assert cell["deltas_live"] == mi.delta_count
+
+    from csvplus_tpu.storage import index_checksums, rebuild_reference
+
+    assert index_checksums(mi.to_index()) == index_checksums(rebuild_reference(mi))
+
+
+def test_served_reads_during_compaction_bitwise_equal(served):
+    """The THREAD001 stress pattern extended to the write path: N
+    submitter threads hammer a served MutableIndex while the background
+    compactor swaps epochs — every result must be bitwise-equal to the
+    serial read on the frozen equivalent."""
+    import threading as _threading
+
+    from csvplus_tpu.row import Row
+    from csvplus_tpu.storage import Compactor
+
+    idx, ids = served
+    mi = _mutable(n=400)
+    for j in range(3):
+        mi.append_rows(
+            [Row({"k": f"d{j}{i}", "v": "x"}) for i in range(20)]
+        )
+    probes = [f"k{i:03d}" for i in range(0, 17)] + ["d11", "nope"]
+    frozen = mi.to_index()
+    serial = [
+        [dict(r) for r in b]
+        for b in frozen._impl.find_rows_many([(p,) for p in probes])
+    ]
+    n_threads = 6
+    out = [None] * n_threads
+    errs = []
+    start = _threading.Barrier(n_threads + 1)
+    with LookupServer(idx, indexes={"mut": mi}) as srv:
+
+        def worker(slot):
+            try:
+                start.wait()
+                for _ in range(5):
+                    futs = [srv.submit(p, index="mut") for p in probes]
+                    got = [
+                        [dict(r) for r in f.result(timeout=30.0)]
+                        for f in futs
+                    ]
+                    if got != serial:
+                        raise AssertionError(f"worker {slot} diverged")
+                out[slot] = True
+            except BaseException as e:
+                errs.append(e)
+
+        ts = [
+            _threading.Thread(target=worker, args=(i,))
+            for i in range(n_threads)
+        ]
+        for t in ts:
+            t.start()
+        with Compactor(mi, min_deltas=1, interval_s=0.0):
+            start.wait()
+            for t in ts:
+                t.join()
+    assert not errs, errs[0]
+    assert all(out)
+    assert mi.delta_count == 0  # the compactor really ran
