@@ -1,7 +1,7 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta trace-smoke chaos check dryrun example coldcheck lint analyze asan
+.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal trace-smoke chaos check dryrun example coldcheck lint analyze asan
 
 test:
 	python -m pytest tests/ -x -q
@@ -9,7 +9,7 @@ test:
 # The standing local gate: unit suite, static analysis, chaos
 # differential, mutable-index storage bench — the set a change must
 # keep green before review.
-check: test lint chaos bench-delta
+check: test lint chaos bench-delta bench-wal
 
 # Static analysis gate (docs/ANALYSIS.md).  The repo AST lint (ctypes
 # boundary + jit retrace rules) always runs; ruff and mypy run when
@@ -106,6 +106,16 @@ bench-serve:
 bench-delta:
 	JAX_PLATFORMS=cpu python bench_delta.py
 
+# Durable mutable-index (WAL) bench: ack-after-fsync append throughput
+# (sync=always vs batch), 200K-row WAL-tail recovery, and lookup
+# latency with live tombstone tiers — with recovered-state checksum
+# parity and zero warm recompiles enforced in-bench.  One compact JSON
+# line last; exits nonzero on a >2x regression vs bench_wal_floor.json.
+# The checked-in record (BENCH_WAL_r11.json) is only (re)written when
+# CSVPLUS_BENCH_WAL_OUT is set.
+bench-wal:
+	JAX_PLATFORMS=cpu python bench_wal.py
+
 # Tracing-subsystem smoke (docs/OBSERVABILITY.md): a traced serving
 # pass on the micro lookup shape must produce per-request span trees,
 # the Chrome-trace export must pass the schema validator, and the
@@ -122,7 +132,7 @@ trace-smoke:
 # typed (dispatcher crashes fail every pending future with
 # ServerCrashed in <1s); every case runs under a watchdog so a hang is
 # a failure; the DISARMED injection hooks must cost <=1% of a served
-# request.  Writes CHAOS_r10.json; the unit-level chaos suite
+# request.  Writes CHAOS_r11.json; the unit-level chaos suite
 # (tests/test_chaos.py) runs first.
 chaos:
 	JAX_PLATFORMS=cpu timeout -k 10 600 python -m pytest tests/test_chaos.py -q

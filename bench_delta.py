@@ -20,8 +20,10 @@ The ISSUE 9 hard contract is enforced INSIDE the bench, not just in the
 unit suite: after EVERY compaction step the live tier set must
 checksum-match a from-scratch host rebuild of the same logical rows
 (bitwise), and warm lookups against the compacted index must record
-zero recompiles (``RecompileWatch.assert_zero``).  A contract breach
-raises — it is never a postmortem.
+zero recompiles (``RecompileWatch.assert_zero``).  ISSUE 10 extends the
+stream with interleaved deletes: a tombstone cycle (deletes + appends,
+one leveled fold, one full merge) must hold the same parity at every
+step.  A contract breach raises — it is never a postmortem.
 
 Contract (matches the other benches): diagnostics go to stderr, stdout
 carries ONE compact JSON record line re-printed last; the run exits
@@ -324,6 +326,25 @@ def main() -> int:
     stats2 = mi.compact_once()
     scenarios["second_compaction"] = stats2
     _assert_parity(mi, "compaction step 2")
+
+    # -- tombstone cycle (ISSUE 10): interleaved appends and deletes -------
+    # hold the same checksum parity through a partial (leveled) fold
+    # and the full merge that drops the tombstones for good
+    for i in range(8):
+        mi.delete((f"d{16 * batch_rows + i}",))
+    mi.append_rows(_delta_rows(64, start=17 * batch_rows))
+    mi.delete((probes[0],))
+    mi.append_rows(_delta_rows(64, start=17 * batch_rows + 64))
+    _assert_parity(mi, "live tombstone tiers")
+    step_stats = mi.compact_step(ratio=2)
+    _assert_parity(mi, "leveled fold with tombstones")
+    stats3 = mi.compact_once()
+    scenarios["tombstone_cycle"] = {
+        "deletes": 10,
+        "leveled_fold": step_stats,
+        "full_merge": stats3,
+    }
+    _assert_parity(mi, "tombstones applied and dropped")
 
     scenarios["zero_recompile_gate"] = _zero_recompile_gate(mi, probes[:256])
     sys.stderr.write(

@@ -21,7 +21,7 @@ differential contract:
   `make trace-smoke`'s disabled-hook gate).
 
 Contract (matches the benches): diagnostics go to stderr, stdout
-carries ONE compact JSON line; CHAOS_r10.json records the full
+carries ONE compact JSON line; CHAOS_r11.json records the full
 evidence — per-case injection counts (``FaultPlan.snapshot``), recovery
 outcomes, serve retry/degrade metrics, telemetry counters
 (``ingest.worker_recovered``), and the overhead measurement.  Exits
@@ -53,7 +53,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 #: Watchdog bound per chaos case: a case that cannot finish inside this
 #: is a hang, which is exactly what the resilience layer must prevent.
 CASE_TIMEOUT_S = float(os.environ.get("CSVPLUS_CHAOS_CASE_TIMEOUT", 120))
-ARTIFACT = os.path.join(REPO, "CHAOS_r10.json")
+ARTIFACT = os.path.join(REPO, "CHAOS_r11.json")
 #: Disarmed-hook budget: injection sites on the serve path may cost at
 #: most this fraction of one served request.
 OVERHEAD_BUDGET_PCT = 1.0
@@ -471,13 +471,115 @@ def case_storage_compact_crash():
     }
 
 
+def case_wal_crash_matrix(tmp_root):
+    """The ISSUE 10 crash-restart matrix: a subprocess child plays a
+    fixed append/delete/compact op list over a durable MutableIndex
+    under ``CSVPLUS_WAL_SYNC=always`` and is killed (injected fatal) at
+    every fsync boundary of the write path — mid WAL append, mid
+    segment seal, post-merge/pre-manifest-rename, post-rename/pre-WAL-
+    truncate — plus a torn-tail partial frame.  Each window must
+    recover checksums bitwise-equal to a fresh in-memory replay of
+    exactly the acked ops (no acked-then-lost record), with zero warm
+    recompiles on the recovered index."""
+    import importlib.util
+
+    from csvplus_tpu.obs.recompile import RecompileWatch
+    from csvplus_tpu.storage import MutableIndex, index_checksums
+
+    child_path = os.path.join(REPO, "tests", "wal_crash_child.py")
+    spec = importlib.util.spec_from_file_location(
+        "wal_crash_child", child_path
+    )
+    child = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(child)
+
+    windows = {}
+    for name, (fault, n_acked, n_replay) in sorted(
+        child.CRASH_WINDOWS.items()
+    ):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["CSVPLUS_WAL_SYNC"] = "always"
+        env.pop("CSVPLUS_FAULTS", None)
+        env.pop("CSVPLUS_WAL_CHILD_TEAR", None)
+        if fault is not None:
+            env["CSVPLUS_FAULTS"] = json.dumps({"faults": [fault]})
+        if name == "torn_tail":
+            env["CSVPLUS_WAL_CHILD_TEAR"] = "1"
+        workdir = os.path.join(tmp_root, f"wal-{name}", "idx")
+        acked_path = os.path.join(tmp_root, f"wal-{name}", "acked.json")
+        os.makedirs(os.path.dirname(workdir), exist_ok=True)
+        proc = subprocess.run(
+            [sys.executable, child_path, workdir, acked_path],
+            env=env, capture_output=True, text=True,
+            timeout=CASE_TIMEOUT_S,
+        )
+        rec: dict = {"exit": proc.returncode}
+        try:
+            with open(acked_path) as f:
+                acked = json.load(f)
+            mi = MutableIndex.open(workdir)
+            ref = child.replay_reference(acked["ops"])
+            probes = [("k003",), ("a05",), ("b02",), ("zz",)]
+            mi.find_rows_many(probes)  # warm-up
+            with RecompileWatch() as w:
+                got = mi.find_rows_many(probes)
+            rec.update(
+                crashed=acked["crashed"] is not None,
+                acked=len(acked["ops"]),
+                recovered_records=mi.recovered_records,
+                truncated_bytes=mi.recovery_info["truncated_bytes"],
+                parity=index_checksums(mi.to_index())
+                == index_checksums(ref.to_index()),
+                answers=[[dict(r) for r in b] for b in got]
+                == [[dict(r) for r in b] for b in ref.find_rows_many(probes)],
+                warm_recompiles=sum(w.delta().values()),
+            )
+            rec["ok"] = bool(
+                proc.returncode == (3 if fault is not None else 0)
+                and rec["crashed"] == (fault is not None)
+                and rec["acked"] == n_acked
+                and rec["recovered_records"] == n_replay
+                and rec["parity"]
+                and rec["answers"]
+                and rec["warm_recompiles"] == 0
+            )
+        except Exception as exc:  # a window that cannot recover at all
+            rec["ok"] = False
+            rec["error"] = f"{type(exc).__name__}: {exc}"
+            rec["stderr_tail"] = proc.stderr[-500:]
+        windows[name] = rec
+    return {
+        "ok": all(v["ok"] for v in windows.values()),
+        "windows_total": len(windows),
+        "windows_failed": sorted(
+            k for k, v in windows.items() if not v["ok"]
+        ),
+        "windows": windows,
+    }
+
+
 # ---- disarmed-hook overhead gate -----------------------------------------
 
 
 def case_disarmed_overhead(idx, ids):
-    """The disarmed inject() fast path, priced against one served
-    request (same discipline as `make trace-smoke`): sites on the serve
-    path must cost <= OVERHEAD_BUDGET_PCT of a request."""
+    """The disarmed inject() fast path, priced against served requests
+    in BOTH regimes the sites actually run in (same discipline as
+    `make trace-smoke`).  The two serve-path sites (`serve:dispatch`,
+    `serve:bounds`) each fire once per dispatch CYCLE, so:
+
+    - coalesced regime: the per-cycle site cost, amortized over the
+      observed mean batch, vs the amortized per-request time;
+    - isolated regime (batch of one): the full two-site cost vs one
+      warm isolated submit->result round trip.
+
+    The original formulation charged the per-cycle sites per REQUEST
+    against the amortized per-request time — a worst-case numerator
+    over a best-case denominator — and only stayed under budget while
+    the measured loop was still half-cold, so the verdict flipped with
+    case ordering.  The serve path is now fully warmed (one complete
+    probe-set pass) before anything is timed, and each regime compares
+    like with like."""
     from csvplus_tpu.resilience import faults
     from csvplus_tpu.serve import LookupServer
 
@@ -489,24 +591,37 @@ def case_disarmed_overhead(idx, ids):
     per_call_s = (time.perf_counter() - t0) / reps
 
     probes = _probes(ids, 2000, seed=3)
+    sites_per_cycle = 2  # serve:dispatch + serve:bounds
     with LookupServer(idx) as srv:
-        for f in [srv.submit(p) for p in probes[:50]]:  # warm
+        for f in [srv.submit(p) for p in probes]:  # full warm pass
             f.result(timeout=30.0)
+        ticks_before = srv.snapshot()["ticks"]
         t0 = time.perf_counter()
         for f in [srv.submit(p) for p in probes]:
             f.result(timeout=30.0)
         per_request_s = (time.perf_counter() - t0) / len(probes)
+        cycles = max(1, srv.snapshot()["ticks"] - ticks_before)
+        mean_batch = len(probes) / cycles
+        iso = probes[:64]
+        t0 = time.perf_counter()
+        for p in iso:
+            srv.submit(p).result(timeout=30.0)
+        iso_rt_s = (time.perf_counter() - t0) / len(iso)
 
-    # two sites sit on a served lookup's path: serve:dispatch (amortized
-    # across the batch, charged per-request here to stay conservative)
-    # and serve:bounds
-    sites_per_request = 2
-    pct = 100.0 * sites_per_request * per_call_s / per_request_s
+    pct_coalesced = (
+        100.0 * sites_per_cycle * per_call_s / (mean_batch * per_request_s)
+    )
+    pct_isolated = 100.0 * sites_per_cycle * per_call_s / iso_rt_s
+    pct = max(pct_coalesced, pct_isolated)
     return {
         "ok": pct <= OVERHEAD_BUDGET_PCT,
         "per_call_ns": round(per_call_s * 1e9, 2),
         "per_request_us": round(per_request_s * 1e6, 2),
-        "sites_per_request": sites_per_request,
+        "isolated_rt_us": round(iso_rt_s * 1e6, 2),
+        "mean_batch": round(mean_batch, 1),
+        "sites_per_cycle": sites_per_cycle,
+        "overhead_pct_coalesced": round(pct_coalesced, 4),
+        "overhead_pct_isolated": round(pct_isolated, 4),
         "overhead_pct": round(pct, 4),
         "budget_pct": OVERHEAD_BUDGET_PCT,
     }
@@ -552,6 +667,10 @@ def main() -> int:
             )
             cases["storage_compact_crash"] = _with_timeout(
                 "storage_compact_crash", case_storage_compact_crash
+            )
+            cases["wal_crash_matrix"] = _with_timeout(
+                "wal_crash_matrix",
+                lambda: case_wal_crash_matrix(tmp_root),
             )
             cases["disarmed_overhead"] = _with_timeout(
                 "disarmed_overhead", lambda: case_disarmed_overhead(idx, ids)

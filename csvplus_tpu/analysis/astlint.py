@@ -1,6 +1,6 @@
 """Repo-specific AST lint: rules generic linters cannot know.
 
-Six rule classes have bitten this codebase (or its measured history)
+Seven rule classes have bitten this codebase (or its measured history)
 and are mechanically checkable from the AST:
 
 * **CTYPES001** — the native scanner boundary.  The C ABI's ``c_char``
@@ -50,8 +50,13 @@ and are mechanically checkable from the AST:
   re-raise, wrap via ``map_error``, or record the failure to
   metrics/telemetry/stderr; narrowly-typed best-effort catches
   (``except (OSError, AttributeError):``) remain legal.
+* **IO001** — the durability boundary (ISSUE 10).  Under ``storage/``,
+  a bare ``open()`` with a write mode in a function that neither
+  ``os.fsync``-es nor publishes via ``os.replace``/``os.rename`` can
+  ack data that exists only in the page cache — the acked-then-lost
+  window the WAL/manifest machinery exists to close.
 
-Each of TRACE001/EAGER001/THREAD001/FAULT001 carries an explicit
+Each of TRACE001/EAGER001/THREAD001/FAULT001/IO001 carries an explicit
 allowance list below (``*_ALLOWED``) that STARTS EMPTY and must stay
 empty for the current tree; additions need review.
 
@@ -75,7 +80,7 @@ __all__ = ["LintFinding", "lint_source", "lint_file", "lint_paths"]
 
 @dataclass(frozen=True)
 class LintFinding:
-    code: str  # "CTYPES001" | "JIT001" | "TRACE001" | "EAGER001" | "THREAD001" | "FAULT001"
+    code: str  # "CTYPES001" | "JIT001" | "TRACE001" | "EAGER001" | "THREAD001" | "FAULT001" | "IO001"
     path: str
     line: int
     message: str
@@ -317,6 +322,7 @@ TRACE001_ALLOWED: frozenset = frozenset()
 EAGER001_ALLOWED: frozenset = frozenset()
 THREAD001_ALLOWED: frozenset = frozenset()
 FAULT001_ALLOWED: frozenset = frozenset()
+IO001_ALLOWED: frozenset = frozenset()
 
 # modules whose per-row loops sit on the measured hot path (r06)
 _EAGER_HOT_DIRS = ("ops",)
@@ -378,6 +384,21 @@ _WORKER_ENTRY_NAMES = (
     "submit_append",
     "on_index_batch",
     "on_compact",
+    # csvplus_tpu/storage durability entry points (ISSUE 10): the
+    # tombstone writer and leveled-compaction step race appends and the
+    # compactor like the r09 writers; the WAL's record/seal/drop
+    # mutators are hit from every writer thread AND the compactor's
+    # checkpoint; wal_sync is the serve dispatcher's per-cycle fsync
+    # barrier; on_recovered lands recovery counts into the serving
+    # metrics monitor at registration time.
+    "delete",
+    "compact_step",
+    "wal_sync",
+    "append_record",
+    "sync_now",
+    "seal_active",
+    "drop_applied",
+    "on_recovered",
 )
 
 _EAGER_TRANSFORM_OPS = frozenset(
@@ -947,6 +968,66 @@ def _thread_findings(tree: ast.Module, path: str) -> List[LintFinding]:
     return findings
 
 
+def _io_findings(tree: ast.Module, path: str) -> List[LintFinding]:
+    """IO001, active only under ``storage/``: a bare ``open()`` with a
+    write mode (``w``/``a``/``x``/``+``) in a function that neither
+    fsyncs nor publishes via atomic rename leaves a durability hole —
+    the data may sit in the page cache when the ack goes out, exactly
+    the acked-then-lost window the WAL exists to close.  Write through
+    the fsync-then-rename idiom (``wal._open_segment``,
+    ``manifest.write_manifest``) or fsync in the same function."""
+    if "storage" not in Path(path).parts:
+        return []
+    findings: List[LintFinding] = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "open"
+        ):
+            continue
+        mode: Optional[str] = None
+        if (
+            len(node.args) >= 2
+            and isinstance(node.args[1], ast.Constant)
+            and isinstance(node.args[1].value, str)
+        ):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if (
+                kw.arg == "mode"
+                and isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, str)
+            ):
+                mode = kw.value.value
+        if mode is None or not any(ch in mode for ch in "wax+"):
+            continue
+        func = _enclosing_function(tree, node.lineno)
+        scope = func if func is not None else tree
+        durable = any(
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in ("fsync", "replace", "rename")
+            for sub in ast.walk(scope)
+        )
+        if durable:
+            continue
+        if _allow_key(path, func) in IO001_ALLOWED:
+            continue
+        findings.append(
+            LintFinding(
+                "IO001",
+                path,
+                node.lineno,
+                f"open(..., {mode!r}) writes in storage/ without an "
+                "fsync or atomic replace/rename in the enclosing "
+                "function — an acked write may sit only in the page "
+                "cache (use the fsync-then-rename idiom)",
+            )
+        )
+    return findings
+
+
 _BROAD_EXCEPT_NAMES = frozenset({"Exception", "BaseException"})
 
 
@@ -1048,6 +1129,7 @@ def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
         findings.extend(e.findings)
     findings.extend(_thread_findings(tree, path))
     findings.extend(_fault_findings(tree, path))
+    findings.extend(_io_findings(tree, path))
     lines = source.splitlines()
     findings = [f for f in findings if not _suppressed(f, lines, tree)]
     findings.sort(key=lambda f: (f.path, f.line, f.code))

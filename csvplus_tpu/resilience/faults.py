@@ -24,6 +24,19 @@ through the tree at existing span/stage boundaries:
   chunk cutter.  An ``io`` raise is an I/O error mid-file, surfaced as
   a :class:`~csvplus_tpu.errors.DataSourceError` with the absolute
   1-based record number per the reference contract.
+* ``storage:compact`` — twice per compaction pass (entry and
+  post-merge/pre-swap).  A raise at either point must leave the
+  pre-compaction tier set live and retryable.
+* ``storage:wal-write`` — top of every WAL record append AND of every
+  segment seal (``storage/wal.py``).  A ``fatal`` raise before the
+  write hit the log means the operation was never acked; recovery must
+  not resurrect it.  Hit counters distinguish the mid-append and
+  mid-seal crash windows in the ``make chaos`` restart matrix.
+* ``storage:manifest-swap`` — brackets the checkpoint's manifest
+  rename in ``MutableIndex._checkpoint``: hit 0 is the
+  post-merge/pre-rename window (recovery must use the OLD base + full
+  WAL), hit 1 the post-rename/pre-WAL-drop window (new base, stale
+  segments swept).  Both recover checksum-equal to the acked stream.
 
 DISCIPLINE: the disarmed path is one module-global ``None`` check per
 site (:func:`inject`), the same budget rule as the tracing subsystem's
@@ -87,6 +100,8 @@ SITES = (
     "ingest:worker",
     "ingest:read",
     "storage:compact",
+    "storage:wal-write",
+    "storage:manifest-swap",
 )
 
 

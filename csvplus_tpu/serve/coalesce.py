@@ -190,6 +190,10 @@ class LookupServer:
         self.admission = AdmissionController(max_pending)
         self.plancache = plancache if plancache is not None else PlanCache()
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        for reg in regs.values():
+            rec = getattr(reg.impl, "recovered_records", 0)
+            if rec:
+                self.metrics.on_recovered(reg.name, rec)
         self._cv = threading.Condition()
         self._pending: List[ServeFuture] = []
         self._open = False
@@ -207,6 +211,9 @@ class LookupServer:
         registry dict is replaced whole under ``self._cv`` — in-flight
         dispatch cycles keep the snapshot they already read."""
         reg = _Registered(str(name), index)
+        rec = getattr(reg.impl, "recovered_records", 0)
+        if rec:
+            self.metrics.on_recovered(reg.name, rec)
         with self._cv:
             regs = dict(self._indexes)
             regs[reg.name] = reg
@@ -461,13 +468,25 @@ class LookupServer:
         """One coalesced append against one mutable index: every
         request's rows concatenate into a SINGLE ``append_rows`` call —
         one columnarize + encode + sort, one delta tier — then each
-        future completes with its own row count."""
+        future completes with its own row count.
+
+        Durable-ack ordering: against a durable index the cycle's WAL
+        records are forced to disk (``wal_sync()`` — the ``batch``
+        policy's fsync barrier; a cheap no-op under ``always``/``off``)
+        BEFORE any future in the cycle completes, so a completed append
+        future is a durability promise, not just a visibility one.  A
+        sync failure fails every future in the cycle — nothing was
+        acked, and recovery will not replay the unsynced tail."""
         rows_all: List[Row] = []
         for req in reqs:
             rows_all.extend(req.rows)
         t_a = time.perf_counter()
+        wal_stats = None
         try:
             reg.impl.append_rows(rows_all)
+            sync = getattr(reg.impl, "wal_sync", None)
+            if sync is not None:
+                wal_stats = sync()
         except Exception as err:
             for req in reqs:
                 self._complete(req, None, err, samples, batch_n=len(reqs))
@@ -483,6 +502,7 @@ class LookupServer:
             append_reqs=len(reqs),
             rows_appended=len(rows_all),
             deltas_live=getattr(reg.impl, "delta_count", None),
+            wal=wal_stats,
         )
 
     def _run_lookups(

@@ -95,6 +95,11 @@ def _new_index_cell() -> Dict[str, object]:
         "compacted_rows": 0,
         "compact_seconds_total": 0.0,
         "last_compact_ms": None,
+        # durable-ack accounting (zero forever on non-durable indexes)
+        "wal_records": 0,
+        "wal_bytes": 0,
+        "wal_fsyncs": 0,
+        "recovered_records": 0,
     }
 
 
@@ -231,9 +236,14 @@ class ServingMetrics:
         append_reqs: int = 0,
         rows_appended: int = 0,
         deltas_live: Optional[int] = None,
+        wal: Optional[Dict[str, int]] = None,
     ) -> None:
         """One dispatch cycle's traffic against one named index — a
-        single lock round per (cycle, index) pair."""
+        single lock round per (cycle, index) pair.  *wal* is the
+        cycle's durable-ack delta (``wal_sync()``'s return value:
+        records/bytes/fsyncs made durable before the cycle's append
+        futures completed); folding it here keeps the r08 one-round
+        rule even on durable indexes."""
         with self._lock:
             cell = self._by_index.setdefault(name, _new_index_cell())
             cell["lookups"] += lookups
@@ -241,6 +251,17 @@ class ServingMetrics:
             cell["rows_appended"] += rows_appended
             if deltas_live is not None:
                 cell["deltas_live"] = int(deltas_live)
+            if wal is not None:
+                cell["wal_records"] += int(wal.get("records", 0))
+                cell["wal_bytes"] += int(wal.get("bytes", 0))
+                cell["wal_fsyncs"] += int(wal.get("fsyncs", 0))
+
+    def on_recovered(self, name: str, records: int) -> None:
+        """WAL records replayed when a recovered durable index was
+        registered (once per registration, not per cycle)."""
+        with self._lock:
+            cell = self._by_index.setdefault(name, _new_index_cell())
+            cell["recovered_records"] += int(records)
 
     def on_compact(
         self,

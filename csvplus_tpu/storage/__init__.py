@@ -1,4 +1,4 @@
-"""Mutable indexes: LSM delta tiers behind the immutable lookup engine.
+"""Mutable indexes: durable LSM delta tiers behind the lookup engine.
 
 The reference csvplus ``Index`` is a frozen sorted materialization
 (csvplus.go:610-920); every layer above it in this repo — the batched
@@ -6,27 +6,45 @@ lookup engine, the serving tier, resilience — assumed a build-once
 read-forever world.  This package opens the write workload without
 touching that machinery: appended rows land as small **sorted delta
 tiers** (each one an ordinary :class:`~csvplus_tpu.index.Index` built
-through the existing ingest + ``create_index`` encode path), lookups
-probe base+deltas through the same multi-tier ``bounds_many`` engine
-and stitch results per probe, and a background **compactor** folds
-deltas into the base with a cache-conscious multi-way merge that swaps
-in atomically under readers (epoch-snapshotted tier sets; the probe
-hot path takes no lock).
+through the existing ingest + ``create_index`` encode path), deletes
+land as **tombstones** that shadow older tiers in both visibility
+modes, lookups probe base+deltas through the same multi-tier
+``bounds_many`` engine and stitch results per probe, and a background
+**compactor** folds tiers with a cache-conscious multi-way merge that
+swaps in atomically under readers (epoch-snapshotted tier sets; the
+probe hot path takes no lock) — either everything into the base each
+pass, or level-by-level under the size-ratio policy for bounded write
+amplification.
+
+Durability: construct with ``directory=`` (or recover with
+``MutableIndex.open``) and every append/delete writes one checksummed
+record to a segmented write-ahead log before it becomes visible,
+fsynced per ``CSVPLUS_WAL_SYNC``; full merges checkpoint the base and
+swap ``MANIFEST.json`` atomically, so a crash at ANY point recovers
+state checksum-equal to replaying the acked logical stream.
 
 * :mod:`~csvplus_tpu.storage.lsm` — :class:`DeltaTier`, :class:`TierSet`,
-  :class:`MutableIndex` (visibility rules, epoch snapshots, the
-  from-scratch rebuild reference used by the parity harness).
+  :class:`MutableIndex` (visibility rules, epoch snapshots, durable
+  append/delete/recovery, the from-scratch rebuild reference used by
+  the parity harness).
 * :mod:`~csvplus_tpu.storage.compact` — the stable searchsorted
-  multi-way merge over union-dictionary code spaces and the
+  multi-way merge over union-dictionary code spaces (tombstone-aware,
+  dead-dictionary pruning), the size-ratio leveling planner, and the
   :class:`Compactor` background thread.
+* :mod:`~csvplus_tpu.storage.wal` — segmented, length-prefixed,
+  crc32-checksummed write-ahead log with torn-tail truncation.
+* :mod:`~csvplus_tpu.storage.manifest` — the atomic
+  write-temp-then-rename recovery manifest.
 
-Hard contract (tests/test_storage.py + ``make bench-delta``): at every
-compaction step, base+deltas checksum-match a from-scratch rebuild of
-the same logical rows (bitwise, positional), and warm lookups against a
-compacted index record zero recompiles.  See docs/STORAGE.md.
+Hard contract (tests/test_storage.py + ``make bench-delta`` + the
+``make chaos`` crash matrix): at every compaction step AND after every
+crash-recovery, base+deltas checksum-match a from-scratch rebuild of
+the acked logical stream (bitwise, positional), and warm lookups
+against a compacted or recovered index record zero recompiles.  See
+docs/STORAGE.md.
 """
 
-from .compact import Compactor, merge_tiers
+from .compact import Compactor, merge_tiers, merge_units, plan_compaction
 from .lsm import (
     DeltaTier,
     MutableIndex,
@@ -34,13 +52,24 @@ from .lsm import (
     index_checksums,
     rebuild_reference,
 )
+from .manifest import MANIFEST_NAME, ManifestError, read_manifest, write_manifest
+from .wal import Wal, WalError, wal_sync_mode
 
 __all__ = [
     "Compactor",
     "DeltaTier",
+    "MANIFEST_NAME",
+    "ManifestError",
     "MutableIndex",
     "TierSet",
+    "Wal",
+    "WalError",
     "index_checksums",
     "merge_tiers",
+    "merge_units",
+    "plan_compaction",
+    "read_manifest",
     "rebuild_reference",
+    "wal_sync_mode",
+    "write_manifest",
 ]

@@ -1,16 +1,18 @@
-"""LSM tier sets: delta tiers, epoch snapshots, multi-tier lookups.
+"""LSM tier sets: delta tiers, tombstones, epoch snapshots, durability.
 
 Layout
 ------
 
 A :class:`MutableIndex` is a **base** tier (an ordinary sorted
-:class:`~csvplus_tpu.index.Index`) plus a tuple of **delta** tiers,
-each itself a small sorted Index built from one append batch through
-the existing encode path (``DeviceTable`` columnarization or the
-staged streamed-ingest pipeline for ``append_csv``).  The logical row
-stream is the concatenation base → delta0 → delta1 → … in append
-order; every read answers as if that stream had been indexed from
-scratch.
+:class:`~csvplus_tpu.index.Index`) plus a tuple of **delta** tiers.  A
+delta tier holds a small sorted Index built from one append batch
+through the existing encode path (``DeviceTable`` columnarization or
+the staged streamed-ingest pipeline for ``append_csv``), a set of
+**tombstone** keys written by :meth:`MutableIndex.delete`, or — after a
+partial (leveled) merge — both.  The logical row stream is the
+concatenation base → delta0 → delta1 → … in append order; every read
+answers as if that stream had been indexed from scratch after applying
+each delete at its stream position.
 
 Visibility (``mode``)
 ---------------------
@@ -25,31 +27,58 @@ Visibility (``mode``)
   append batch may still hold duplicates).  Equal to rebuilding after
   dropping each row whose full key reappears in any LATER tier.
 
+Tombstones shadow in BOTH modes: a tombstone at tier position *p*
+erases every matching full key in tiers strictly older than *p* (rows
+appended after the delete are visible again).  A full merge into the
+base drops tombstones permanently; a partial merge carries the
+surviving tombstone set on the merged tier (it must keep shadowing
+out-of-range older tiers).
+
+Durability (ISSUE 10)
+---------------------
+
+Pass ``directory=`` at construction (or use :meth:`MutableIndex.open`)
+and every append/delete writes one checksummed record to a segmented
+write-ahead log (:mod:`~csvplus_tpu.storage.wal`) BEFORE the tier
+becomes visible, fsynced per ``CSVPLUS_WAL_SYNC`` (``always`` |
+``batch`` | ``off``).  Full compactions checkpoint: the merged base
+persists via the versioned ``Index.write_to`` format, the WAL seals its
+active segment, and ``MANIFEST.json`` swaps atomically
+(:mod:`~csvplus_tpu.storage.manifest`); applied segments are then
+dropped.  :meth:`open` recovers by loading the manifest's base and
+replaying only WAL records newer than its ``applied_lsn``, truncating a
+torn final record — recovered state is bitwise-equal
+(:func:`index_checksums`) to replaying the acked logical stream into a
+fresh index, the crash-matrix contract ``make chaos`` enforces.
+
 Concurrency (the r10 epoch rule)
 --------------------------------
 
 All tier-list state lives in one immutable :class:`TierSet`; readers
 pin it with a single attribute read (``self._tiers`` — atomic under
 the GIL) and never take a lock on the probe hot path.  Writers
-(``append_*`` / ``compact_once``) build a NEW TierSet and swap it
-under ``self._lock``.  The compactor merges OUTSIDE the lock against
-its pinned snapshot and swaps only the merged prefix, so appends
-landing mid-merge survive as the new tier list's tail.  ``append_rows``
-and ``compact_once`` are THREAD001 worker entries
+(``append_*`` / ``delete`` / ``compact_once`` / ``compact_step``)
+build a NEW TierSet and swap it under ``self._lock``.  The compactor
+merges OUTSIDE the lock against its pinned snapshot and swaps only the
+merged range, so appends landing mid-merge survive as the new tier
+list's tail.  ``append_rows``, ``delete``, ``compact_once``,
+``compact_step`` and ``wal_sync`` are THREAD001 worker entries
 (analysis/astlint.py): every shared-state mutation below them must sit
 under a lock, with zero allowances.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
-from ..index import Index, create_index
+from ..index import Index, create_index, load_index
 from ..resilience import faults
 from ..row import Row
 from ..source import take_rows
+from ..utils.env import env_int
 from ..utils.observe import telemetry
 
 __all__ = [
@@ -65,20 +94,34 @@ _MODES = ("append", "upsert")
 
 
 class DeltaTier:
-    """One append batch, materialized as a small sorted Index."""
+    """One append batch and/or tombstone set at one stream position.
 
-    __slots__ = ("seq", "index")
+    ``index`` is the batch's small sorted Index (None for a pure
+    tombstone tier); ``tombs`` is a sorted tuple of full-width key
+    tuples that shadow every strictly OLDER tier (never this tier's own
+    rows — after a partial merge a tier carries both, and its rows were
+    appended after its deletes)."""
 
-    def __init__(self, seq: int, index: Index):
+    __slots__ = ("seq", "index", "tombs", "tomb_set")
+
+    def __init__(self, seq: int, index: Optional[Index],
+                 tombs: Sequence[Tuple[str, ...]] = ()):
         self.seq = seq
         self.index = index
+        self.tombs: Tuple[Tuple[str, ...], ...] = tuple(
+            sorted(set(tuple(k) for k in tombs))
+        )
+        self.tomb_set: FrozenSet[Tuple[str, ...]] = frozenset(self.tombs)
 
     @property
     def nrows(self) -> int:
-        return len(self.index._impl)
+        return 0 if self.index is None else len(self.index._impl)
 
     def __repr__(self) -> str:  # debugging aid only
-        return f"DeltaTier(seq={self.seq}, nrows={self.nrows})"
+        return (
+            f"DeltaTier(seq={self.seq}, nrows={self.nrows}, "
+            f"tombs={len(self.tombs)})"
+        )
 
 
 class TierSet:
@@ -97,25 +140,32 @@ class TierSet:
         self.deltas = deltas
 
     def indexes(self) -> Tuple[Index, ...]:
-        """All tiers oldest→newest (base first)."""
-        return (self.base,) + tuple(d.index for d in self.deltas)
+        """All ROW tiers oldest→newest (base first; pure tombstone
+        tiers carry no rows and are skipped)."""
+        return (self.base,) + tuple(
+            d.index for d in self.deltas if d.index is not None
+        )
 
 
 class MultiBounds:
-    """Pinned tier set + per-tier bounds for one probe batch.
+    """Pinned tier set + per-row-tier bounds for one probe batch.
 
     Opaque handle between :meth:`MutableIndex.bounds_many` and
     :meth:`MutableIndex.rows_for_bounds` — pinning the TierSet here
     keeps the two phases epoch-consistent even when the compactor
     swaps between them (the serving tier calls them separately).
-    """
+    ``positions`` maps each bounds row back to its tier-stream position
+    (base = 0, delta *i* = *i*+1) so tombstone shadowing can compare
+    ages across row and tombstone tiers."""
 
-    __slots__ = ("tiers", "per_tier", "probes")
+    __slots__ = ("tiers", "per_tier", "probes", "row_tiers", "positions")
 
-    def __init__(self, tiers: TierSet, per_tier, probes):
+    def __init__(self, tiers: TierSet, per_tier, probes, row_tiers, positions):
         self.tiers = tiers
         self.per_tier = per_tier
         self.probes = probes
+        self.row_tiers = row_tiers
+        self.positions = positions
 
 
 def tier_rows(impl) -> List[Row]:
@@ -148,17 +198,32 @@ def _upsert_filter(streams: List[List[Row]], key_cols: Sequence[str]) -> List[Li
 
 
 def rebuild_reference(mindex: "MutableIndex", ts: Optional[TierSet] = None) -> Index:
-    """From-scratch rebuild of the pinned tier set's logical rows —
-    the parity harness's ground truth.  Routes through the HOST
-    ``create_index`` build (stable Python sort over Row dicts), a
+    """From-scratch rebuild of the pinned tier set's logical stream —
+    the parity harness's ground truth.  Replays tier events in order
+    (a tier's tombstones erase matching keys from everything
+    accumulated so far, THEN its rows append), applies the upsert
+    newest-wins rule to the survivors, and routes through the HOST
+    ``create_index`` build (stable Python sort over Row dicts) — a
     completely separate code path from the compactor's packed
     searchsorted merge, so agreement is meaningful."""
     ts = ts if ts is not None else mindex.tiers()
-    streams = _logical_streams(ts)
+    cols = mindex.columns
+    streams: List[List[Row]] = [tier_rows(ts.base._impl)]
+    for d in ts.deltas:
+        if d.tombs:
+            dead = d.tomb_set
+            streams = [
+                [r for r in rows if tuple(r[c] for c in cols) not in dead]
+                for rows in streams
+            ]
+        if d.index is not None:
+            streams.append(tier_rows(d.index._impl))
+        else:
+            streams.append([])
     if mindex.mode == "upsert":
-        streams = _upsert_filter(streams, mindex.columns)
+        streams = _upsert_filter(streams, cols)
     rows = [Row(r) for s in streams for r in s]
-    return create_index(take_rows(rows), mindex.columns)
+    return create_index(take_rows(rows), cols)
 
 
 def index_checksums(index: Index, columns: Optional[Sequence[str]] = None) -> Dict[str, int]:
@@ -186,8 +251,11 @@ class MutableIndex:
     Implements the lookup-impl protocol the serving tier consumes
     (``columns`` / ``bounds_many`` / ``rows_for_bounds`` /
     ``find_rows_many``) plus the write surface (``append_rows`` /
-    ``append_table`` / ``append_csv`` / ``compact_once``), so a
-    ``LookupServer`` can register one directly.
+    ``append_table`` / ``append_csv`` / ``delete`` / ``compact_once``
+    / ``compact_step``), so a ``LookupServer`` can register one
+    directly.  With ``directory=`` the write surface is durable (WAL +
+    manifest, see the module docstring); ``wal_sync()`` is the serving
+    tier's per-cycle ack barrier.
     """
 
     # lookup-protocol compatibility: the host-fallback oracle checks
@@ -195,7 +263,9 @@ class MutableIndex:
     # a MutableIndex IS its own host-correct fallback
     dev = None
 
-    def __init__(self, base: Index, *, mode: str = "append", ingest_device=None):
+    def __init__(self, base: Index, *, mode: str = "append", ingest_device=None,
+                 directory: Optional[str] = None, wal_sync: Optional[str] = None,
+                 _manifest: Optional[Dict[str, object]] = None):
         if not isinstance(base, Index):
             raise TypeError("MutableIndex wraps an existing Index as its base tier")
         if mode not in _MODES:
@@ -209,17 +279,107 @@ class MutableIndex:
         self._ingest_device = ingest_device
         self._lock = threading.Lock()
         # serializes whole compaction passes (snapshot -> merge -> swap):
-        # the swap-prefix invariant assumes at most one in-flight merge
+        # the swap-range invariant assumes at most one in-flight merge
         self._compact_lock = threading.Lock()
         self._tiers = TierSet(0, base, ())
         self._next_seq = 1
         self._compactions = 0
         self._compact_seconds = 0.0
+        # durability state (all None/0 for a memory-only index)
+        self._dir = directory
+        self._wal = None
+        self._ckpt = 0
+        self._applied_lsn = 0
+        self._base_file: Optional[str] = None
+        self.recovered_records = 0
+        self.recovery_info: Optional[Dict[str, object]] = None
+        if directory is None:
+            return
+        from . import manifest as mf
+        from .wal import Wal
+
+        if _manifest is None:
+            # fresh durable directory: persist the base, start the WAL,
+            # publish the first manifest — all durable before any ack
+            os.makedirs(directory, exist_ok=True)
+            if os.path.exists(os.path.join(directory, mf.MANIFEST_NAME)):
+                raise mf.ManifestError(
+                    f"{directory}: already a durable MutableIndex directory "
+                    f"(use MutableIndex.open)"
+                )
+            self._ckpt = 1
+            self._base_file = f"base-{self._ckpt:08d}.idx"
+            path = os.path.join(directory, self._base_file)
+            base.write_to(path)
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            self._wal = Wal.create(directory, sync=wal_sync,
+                                   columns=self._columns)
+            mf.write_manifest(directory, mf.manifest_doc(
+                mode=self.mode, key_columns=self._columns,
+                checkpoint=self._ckpt, base=self._base_file, applied_lsn=0,
+                segments=self._wal.segment_names(),
+            ))
+        else:
+            # recovery: replay the WAL tail newer than the manifest's
+            # applied_lsn through the SAME delta-encode path appends ride
+            man = _manifest
+            self._ckpt = int(man["checkpoint"])  # type: ignore[arg-type]
+            self._applied_lsn = int(man["applied_lsn"])  # type: ignore[arg-type]
+            self._base_file = str(man["base"])
+            self._next_seq = self._applied_lsn + 1
+            wal, replay, info = Wal.open(
+                directory, self._applied_lsn, sync=wal_sync,
+                columns=self._columns,
+            )
+            self._wal = wal
+            for doc in replay:
+                lsn = int(doc["lsn"])
+                if doc.get("op") == "del":
+                    delta = DeltaTier(lsn, None, (tuple(doc["key"]),))
+                else:
+                    rows = [Row(r) for r in doc["rows"]]
+                    delta = DeltaTier(lsn, self._build_delta_index(rows))
+                ts = self._tiers
+                self._tiers = TierSet(ts.epoch + 1, ts.base,
+                                      ts.deltas + (delta,))
+                self._next_seq = lsn + 1
+            self.recovered_records = len(replay)
+            self.recovery_info = info
+            mf.remove_stale(directory, man)
 
     @classmethod
-    def create(cls, src, columns: Sequence[str], *, mode: str = "append", ingest_device=None) -> "MutableIndex":
-        """Build the base tier with ``create_index`` and wrap it."""
-        return cls(create_index(src, columns), mode=mode, ingest_device=ingest_device)
+    def create(cls, src, columns: Sequence[str], *, mode: str = "append",
+               ingest_device=None, directory: Optional[str] = None,
+               wal_sync: Optional[str] = None) -> "MutableIndex":
+        """Build the base tier with ``create_index`` and wrap it
+        (durably when *directory* is given)."""
+        return cls(create_index(src, columns), mode=mode,
+                   ingest_device=ingest_device, directory=directory,
+                   wal_sync=wal_sync)
+
+    @classmethod
+    def open(cls, directory: str, *, ingest_device=None,
+             wal_sync: Optional[str] = None) -> "MutableIndex":
+        """Recover a durable MutableIndex: load the manifest's base
+        tier, replay the unsealed WAL tail (truncating a torn final
+        record), and sweep crash leftovers.  The recovered state is
+        bitwise-equal to replaying the acked logical stream into a
+        fresh index."""
+        from . import manifest as mf
+
+        man = mf.read_manifest(directory)
+        base = load_index(os.path.join(directory, str(man["base"])))
+        if list(man["key_columns"]) != list(base._impl.columns):
+            raise mf.ManifestError(
+                f"{directory}: manifest key columns {man['key_columns']!r} "
+                f"disagree with base tier columns {base._impl.columns!r}"
+            )
+        return cls(base, mode=str(man["mode"]), ingest_device=ingest_device,
+                   directory=directory, wal_sync=wal_sync, _manifest=man)
 
     # -- lookup-impl protocol ----------------------------------------------
 
@@ -241,6 +401,10 @@ class MutableIndex:
     def delta_count(self) -> int:
         return len(self._tiers.deltas)
 
+    @property
+    def durable(self) -> bool:
+        return self._wal is not None
+
     def tiers(self) -> TierSet:
         """Pin the current tier-set epoch (one atomic read)."""
         return self._tiers
@@ -255,30 +419,47 @@ class MutableIndex:
         with self._lock:
             compactions = self._compactions
             compact_s = self._compact_seconds
-        return {
+            ckpt = self._ckpt
+            applied = self._applied_lsn
+        out = {
             "mode": self.mode,
             "epoch": ts.epoch,
             "base_rows": len(ts.base._impl),
             "deltas": len(ts.deltas),
             "delta_rows": sum(d.nrows for d in ts.deltas),
+            "tombstones": sum(len(d.tombs) for d in ts.deltas),
             "compactions": compactions,
             "compact_seconds_total": round(compact_s, 6),
         }
+        if self._wal is not None:
+            out["wal"] = self._wal.stats()
+            out["checkpoint"] = ckpt
+            out["applied_lsn"] = applied
+            out["recovered_records"] = self.recovered_records
+        return out
 
     # -- reads (no lock on this path) --------------------------------------
 
     def bounds_many(self, probes: Sequence[Sequence[str]]) -> MultiBounds:
         """Per-tier bounds for the whole probe batch: one vectorized
-        ``bounds_many`` pass per tier (the existing multi-tier
-        ``point_bounds_many`` machinery), pinned to one epoch."""
+        ``bounds_many`` pass per ROW tier (the existing multi-tier
+        ``point_bounds_many`` machinery), pinned to one epoch.
+        Tombstone tiers hold no rows — they join at merge time via
+        the pinned TierSet."""
         norm = [(p,) if isinstance(p, str) else tuple(p) for p in probes]
         width = len(self._columns)
         for p in norm:
             if len(p) > width:
                 raise ValueError("too many columns in Index.find()")
         ts = self._tiers
-        per_tier = [ix._impl.bounds_many(norm) for ix in ts.indexes()]
-        return MultiBounds(ts, per_tier, norm)
+        row_tiers = [ts.base] + [
+            d.index for d in ts.deltas if d.index is not None
+        ]
+        positions = [0] + [
+            p + 1 for p, d in enumerate(ts.deltas) if d.index is not None
+        ]
+        per_tier = [ix._impl.bounds_many(norm) for ix in row_tiers]
+        return MultiBounds(ts, per_tier, norm, row_tiers, positions)
 
     def rows_for_bounds(self, mb: MultiBounds) -> List[List[Row]]:
         """Merge per-tier bounds into per-probe row blocks with ONE
@@ -287,15 +468,24 @@ class MutableIndex:
 
         Fast paths: a probe matched by a single tier returns that
         tier's block directly; a full-width probe needs no key-level
-        merge (all rows share one key — ``append`` concatenates in
-        tier order, ``upsert`` decodes only the newest matching tier).
-        Only multi-tier PREFIX probes pay the host key-merge."""
-        tiers = mb.tiers.indexes()
+        merge (all rows share one key — tombstones mask whole tiers by
+        age, ``append`` concatenates survivors in tier order,
+        ``upsert`` decodes only the newest matching tier).  Only
+        PREFIX probes overlapping a live tombstone pay the host
+        key-merge."""
+        ts = mb.tiers
+        row_tiers = mb.row_tiers
+        positions = mb.positions
         per_tier = mb.per_tier
-        n_tiers = len(tiers)
+        n_tiers = len(row_tiers)
         n_probes = len(mb.probes)
         width = len(self._columns)
         upsert = self.mode == "upsert"
+        tombs = [
+            (p + 1, d.tomb_set)
+            for p, d in enumerate(ts.deltas)
+            if d.tombs
+        ]
         eff: List[List[Tuple[int, int]]] = [
             [(0, 0)] * n_probes for _ in range(n_tiers)
         ]
@@ -306,7 +496,27 @@ class MutableIndex:
             ]
             if not live:
                 continue
-            if len(live) == 1 or (upsert and len(mb.probes[i]) == width):
+            probe = mb.probes[i]
+            full = len(probe) == width
+            if tombs and full:
+                # whole-tier age mask: the newest tombstone holding this
+                # exact key erases every strictly older tier's rows
+                shadow = -1
+                for tp, tset in tombs:
+                    if tp > shadow and probe in tset:
+                        shadow = tp
+                if shadow >= 0:
+                    live = [t for t in live if positions[t] >= shadow]
+                    if not live:
+                        continue
+            elif tombs and any(tp > positions[live[0]] for tp, _ in tombs):
+                # prefix probe with a tombstone newer than some matched
+                # tier: individual keys may be shadowed — host key-merge
+                for t in live:
+                    eff[t][i] = per_tier[t][i]
+                plan[i] = ("merge", tuple(live))
+                continue
+            if len(live) == 1 or (upsert and full):
                 t = live[-1] if upsert else live[0]
                 # single visible tier (or newest-wins point probe):
                 # decode exactly one tier's range, shadowed rows never
@@ -316,12 +526,12 @@ class MutableIndex:
             else:
                 for t in live:
                     eff[t][i] = per_tier[t][i]
-                kind = "concat" if len(mb.probes[i]) == width else "merge"
+                kind = "concat" if full else "merge"
                 plan[i] = (kind, tuple(live))
         decoded: List[Optional[List[List[Row]]]] = [None] * n_tiers
         for t in range(n_tiers):
             if any(hi > lo for lo, hi in eff[t]):
-                decoded[t] = tiers[t]._impl.rows_for_bounds(eff[t])
+                decoded[t] = row_tiers[t]._impl.rows_for_bounds(eff[t])
         out: List[List[Row]] = []
         for i in range(n_probes):
             kind, live = plan[i]
@@ -339,9 +549,10 @@ class MutableIndex:
             else:
                 out.append(
                     _merge_blocks(
-                        [(t, decoded[t][i]) for t in live],
+                        [(positions[t], decoded[t][i]) for t in live],
                         self._columns,
                         upsert,
+                        tombs,
                     )
                 )
         return out
@@ -357,21 +568,30 @@ class MutableIndex:
 
     # -- writes (THREAD001 entries) ----------------------------------------
 
+    def _build_delta_index(self, rows: List[Row]) -> Index:
+        """One batch through the standard per-tier encode path — shared
+        by the live append surface and WAL replay so a recovered tier
+        is built exactly like the acked one was."""
+        from ..columnar.ingest import source_from_table
+        from ..columnar.table import DeviceTable
+
+        table = DeviceTable.from_rows(rows, device=self._device)
+        return create_index(source_from_table(table), self._columns)
+
     def append_rows(self, rows: Sequence) -> int:
         """Append a batch of rows as one new delta tier.
 
         The batch columnarizes through ``DeviceTable.from_rows`` and
         the device ``create_index`` build — the same per-tier encode
-        path every index rides — then lands as a sorted delta."""
+        path every index rides — then lands as a sorted delta.  On a
+        durable index the batch's WAL record is written (and under
+        ``CSVPLUS_WAL_SYNC=always`` fsynced) BEFORE the tier becomes
+        visible; a WAL failure acks nothing and changes nothing."""
         rows = [r if isinstance(r, Row) else Row(r) for r in rows]
         if not rows:
             return 0
-        from ..columnar.ingest import source_from_table
-        from ..columnar.table import DeviceTable
-
-        table = DeviceTable.from_rows(rows, device=self._device)
-        idx = create_index(source_from_table(table), self._columns)
-        self._push_delta(idx)
+        idx = self._build_delta_index(rows)
+        self._push_delta(idx, [dict(r) for r in rows])
         return len(rows)
 
     def append_table(self, table) -> int:
@@ -381,7 +601,7 @@ class MutableIndex:
         if table.nrows == 0:
             return 0
         idx = create_index(source_from_table(table), self._columns)
-        self._push_delta(idx)
+        self._push_delta(idx, None)
         return table.nrows
 
     def append_csv(self, path: str, *, device: Optional[str] = None, shards=None) -> int:
@@ -399,26 +619,85 @@ class MutableIndex:
         n = len(idx._impl)
         if n == 0:
             return 0
-        self._push_delta(idx)
+        self._push_delta(idx, None)
         return n
 
-    def _push_delta(self, idx: Index) -> None:
+    def delete(self, key: Sequence[str]) -> None:
+        """Tombstone one full-width key: every currently visible row
+        with this exact key disappears (in both visibility modes); rows
+        appended afterwards are visible again.  Tombstones drop
+        permanently at the next full merge.  Durable indexes write the
+        tombstone's WAL record before it takes effect."""
+        norm = (key,) if isinstance(key, str) else tuple(key)
+        if len(norm) != len(self._columns):
+            raise ValueError(
+                f"delete() needs a full-width key ({len(self._columns)} "
+                f"columns, got {len(norm)})"
+            )
         with self._lock:
-            ts = self._tiers
-            delta = DeltaTier(self._next_seq, idx)
+            seq = self._next_seq
             self._next_seq += 1
+            if self._wal is not None:
+                self._wal.append_record(
+                    seq, {"lsn": seq, "op": "del", "key": list(norm)}
+                )
+            ts = self._tiers
+            self._tiers = TierSet(
+                ts.epoch + 1, ts.base,
+                ts.deltas + (DeltaTier(seq, None, (norm,)),),
+            )
+
+    def wal_sync(self) -> Dict[str, int]:
+        """Force buffered WAL records durable (the ``batch`` policy's
+        ack barrier; cheap no-op shapes otherwise) and return the
+        cycle-delta counters {records, bytes, fsyncs}.  The serving
+        tier calls this once per dispatch cycle BEFORE completing
+        append futures — the ack-after-fsync ordering."""
+        w = self._wal
+        if w is None:
+            return {"records": 0, "bytes": 0, "fsyncs": 0}
+        w.sync_now()
+        return w.stats_delta()
+
+    def close(self) -> None:
+        """Flush and close the WAL (memory-only indexes: no-op)."""
+        if self._wal is not None:
+            self._wal.close()
+
+    def _push_delta(self, idx: Index, wal_rows: Optional[List[Dict]]) -> None:
+        if wal_rows is None and self._wal is not None:
+            # append_table/append_csv: log the tier's own sorted rows
+            # (replaying a stable sort of already-sorted rows rebuilds
+            # the identical tier)
+            wal_rows = [dict(r) for r in tier_rows(idx._impl)]
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            if self._wal is not None:
+                self._wal.append_record(
+                    seq, {"lsn": seq, "op": "rows", "rows": wal_rows}
+                )
+            ts = self._tiers
+            delta = DeltaTier(seq, idx)
             self._tiers = TierSet(ts.epoch + 1, ts.base, ts.deltas + (delta,))
 
+    # -- compaction --------------------------------------------------------
+
     def compact_once(self) -> Optional[Dict[str, object]]:
-        """Merge the current deltas into the base and swap the merged
-        tier set in atomically.  Returns merge stats, or None when
-        there was nothing to compact.
+        """Merge ALL current deltas into the base and swap the merged
+        tier set in atomically (tombstones apply and then drop for
+        good).  Returns merge stats, or None when there was nothing to
+        compact.  On a durable index a successful full merge
+        checkpoints (new base file + sealed WAL + manifest swap).
 
         Crash safety: the fault-injection site ``storage:compact``
         fires once on entry and once just before the swap; an
         exception at either point (or anywhere in the merge) leaves
         ``self._tiers`` untouched — the pre-compaction tier set stays
-        live and a retry starts clean.  Appends racing the merge are
+        live and a retry starts clean.  A crash DURING the checkpoint
+        (after the in-memory swap) leaves the durable state stale but
+        consistent: recovery replays the original WAL records and
+        reaches the same logical stream.  Appends racing the merge are
         preserved: only the pinned snapshot's deltas are folded in,
         newer deltas carry over as the new tail."""
         faults.inject("storage:compact")
@@ -426,55 +705,199 @@ class MutableIndex:
             ts = self._tiers
             if not ts.deltas:
                 return None
-            from .compact import merge_tiers
+            return self._compact_full(ts)
 
-            n_in = sum(len(ix._impl) for ix in ts.indexes())
-            t0 = time.perf_counter()
-            with telemetry.stage("storage:compact", n_in) as _t:
-                merged = merge_tiers(list(ts.indexes()), self._columns, self.mode)
-                _t["deltas"] = len(ts.deltas)
-                # the pre-swap crash window: a compactor death AFTER the
-                # merge but BEFORE the swap must also leave the old tier
-                # set intact (chaos scenario `storage_compact_crash`)
-                faults.inject("storage:compact")
-                seconds = time.perf_counter() - t0
-                with self._lock:
-                    cur = self._tiers
-                    self._tiers = TierSet(
-                        cur.epoch + 1, merged, cur.deltas[len(ts.deltas):]
+    def compact_step(self, *, ratio: Optional[int] = None) -> Optional[Dict[str, object]]:
+        """One pass of the size-ratio leveling policy: fold the oldest
+        run of ≥ *ratio* same-level deltas into one merged delta (a
+        PARTIAL merge — bounded write amplification, base untouched,
+        no checkpoint), or escalate to a full merge once the delta
+        mass reaches 1/*ratio* of the base.  Returns the pass's stats
+        (``kind`` = ``partial`` | ``full``), or None when the policy
+        finds nothing due.  *ratio* defaults to ``CSVPLUS_LSM_RATIO``
+        (4)."""
+        if ratio is None:
+            ratio = env_int("CSVPLUS_LSM_RATIO", 4)
+        if ratio < 2:
+            raise ValueError("compact_step ratio must be >= 2")
+        faults.inject("storage:compact")
+        with self._compact_lock:
+            ts = self._tiers
+            from .compact import plan_compaction
+
+            sel = plan_compaction(ts, ratio)
+            if sel is None:
+                return None
+            kind, span = sel
+            if kind == "full":
+                return self._compact_full(ts)
+            i, j = span
+            return self._compact_partial(ts, i, j)
+
+    def _compact_full(self, ts: TierSet) -> Dict[str, object]:
+        """Full fold (caller holds ``_compact_lock``)."""
+        from .compact import merge_units, units_of
+
+        n_in = sum(len(ix._impl) for ix in ts.indexes())
+        t0 = time.perf_counter()
+        with telemetry.stage("storage:compact", n_in) as _t:
+            merged, _ = merge_units(
+                units_of(ts), self._columns, self.mode, drop_tombstones=True
+            )
+            _t["deltas"] = len(ts.deltas)
+            # the pre-swap crash window: a compactor death AFTER the
+            # merge but BEFORE the swap must also leave the old tier
+            # set intact (chaos scenario `storage_compact_crash`)
+            faults.inject("storage:compact")
+            seconds = time.perf_counter() - t0
+            with self._lock:
+                cur = self._tiers
+                self._tiers = TierSet(
+                    cur.epoch + 1, merged, cur.deltas[len(ts.deltas):]
+                )
+                self._compactions += 1
+                self._compact_seconds += seconds
+            _t["rows_out"] = len(merged._impl)
+        if self._wal is not None:
+            self._checkpoint(merged, ts.deltas[-1].seq)
+        return {
+            "kind": "full",
+            "deltas": len(ts.deltas),
+            "rows_in": n_in,
+            "rows_out": len(merged._impl),
+            "seconds": seconds,
+            "epoch": self._tiers.epoch,
+        }
+
+    def _compact_partial(self, ts: TierSet, i: int, j: int) -> Dict[str, object]:
+        """Merge the contiguous delta run [i, j) into ONE delta tier
+        (caller holds ``_compact_lock``).  In-range shadowing applies
+        (upsert dead groups and tombstoned rows drop); surviving
+        tombstones ride the merged tier so out-of-range older tiers
+        stay shadowed.  The base and the manifest are untouched —
+        recovery replays the ORIGINAL records and reaches the same
+        logical stream."""
+        from .compact import delta_units, merge_units
+
+        run = ts.deltas[i:j]
+        n_in = sum(d.nrows for d in run)
+        t0 = time.perf_counter()
+        with telemetry.stage("storage:compact", n_in) as _t:
+            merged, tombs = merge_units(
+                delta_units(run), self._columns, self.mode,
+                drop_tombstones=False,
+            )
+            _t["deltas"] = len(run)
+            _t["kind"] = "partial"
+            faults.inject("storage:compact")
+            seconds = time.perf_counter() - t0
+            n_out = len(merged._impl)
+            with self._lock:
+                cur = self._tiers
+                # appends only extend the tail and merges serialize on
+                # _compact_lock, so cur.deltas[i:j] is still `run`
+                if n_out or tombs:
+                    new = (
+                        DeltaTier(run[-1].seq, merged if n_out else None, tombs),
                     )
-                    self._compactions += 1
-                    self._compact_seconds += seconds
-                _t["rows_out"] = len(merged._impl)
-            return {
-                "deltas": len(ts.deltas),
-                "rows_in": n_in,
-                "rows_out": len(merged._impl),
-                "seconds": seconds,
-                "epoch": self._tiers.epoch,
-            }
+                else:
+                    new = ()
+                self._tiers = TierSet(
+                    cur.epoch + 1, cur.base,
+                    cur.deltas[:i] + new + cur.deltas[j:],
+                )
+                self._compactions += 1
+                self._compact_seconds += seconds
+            _t["rows_out"] = n_out
+        return {
+            "kind": "partial",
+            "deltas": len(run),
+            "rows_in": n_in,
+            "rows_out": n_out,
+            "seconds": seconds,
+            "epoch": self._tiers.epoch,
+        }
+
+    def _checkpoint(self, merged: Index, applied_lsn: int) -> None:
+        """Publish a full merge durably: persist the merged base
+        (versioned ``write_to`` format), seal the active WAL segment,
+        swap the manifest atomically, then drop applied segments and
+        stale files.  ``storage:manifest-swap`` fires in the
+        pre-rename (hit 0) and post-rename/pre-drop (hit 1) windows —
+        a crash in either recovers to the same logical stream."""
+        from . import manifest as mf
+
+        directory = self._dir
+        with self._lock:
+            ck = self._ckpt + 1
+        base_name = f"base-{ck:08d}.idx"
+        final = os.path.join(directory, base_name)
+        tmp = final + ".tmp"
+        merged.write_to(tmp)
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, final)
+        self._wal.seal_active()
+        faults.inject("storage:manifest-swap")
+        doc = mf.manifest_doc(
+            mode=self.mode, key_columns=self._columns, checkpoint=ck,
+            base=base_name, applied_lsn=int(applied_lsn),
+            segments=self._wal.segment_names(),
+        )
+        mf.write_manifest(directory, doc)
+        faults.inject("storage:manifest-swap")
+        with self._lock:
+            self._ckpt = ck
+            self._applied_lsn = int(applied_lsn)
+            self._base_file = base_name
+        self._wal.drop_applied(int(applied_lsn))
+        mf.remove_stale(directory, doc)
 
     def to_index(self) -> Index:
         """A frozen Index equal to fully compacting the CURRENT tier
         set, without swapping it in (the concurrent-read tests' frozen
         equivalent)."""
-        from .compact import merge_tiers
+        from .compact import merge_units, units_of
 
         ts = self._tiers
         if not ts.deltas:
             return ts.base
-        return merge_tiers(list(ts.indexes()), self._columns, self.mode)
+        merged, _ = merge_units(
+            units_of(ts), self._columns, self.mode, drop_tombstones=True
+        )
+        return merged
 
 
 def _merge_blocks(
-    tagged: List[Tuple[int, List[Row]]], key_cols: Sequence[str], upsert: bool
+    tagged: List[Tuple[int, List[Row]]],
+    key_cols: Sequence[str],
+    upsert: bool,
+    tombs: Sequence[Tuple[int, FrozenSet[tuple]]] = (),
 ) -> List[Row]:
     """Key-level merge of per-tier row blocks for one PREFIX probe.
 
-    Each block is sorted by full key (it came out of a sorted tier);
-    the rebuild's order for the union is (key, tier, within-tier
-    position), which a stable sort by key alone reproduces because the
-    input list is built tier-by-tier in position order."""
+    Each block is sorted by full key (it came out of a sorted tier) and
+    tagged with its tier-stream position; a tombstone at position *tp*
+    erases matching keys from strictly older blocks.  The rebuild's
+    order for the surviving union is (key, tier, within-tier position),
+    which a stable sort by key alone reproduces because the input list
+    is built tier-by-tier in position order."""
+    if tombs:
+        filtered: List[Tuple[int, List[Row]]] = []
+        for pos, rows in tagged:
+            newer = [tset for tp, tset in tombs if tp > pos]
+            if newer:
+                rows = [
+                    r for r in rows
+                    if not any(
+                        tuple(r[c] for c in key_cols) in tset for tset in newer
+                    )
+                ]
+            filtered.append((pos, rows))
+        tagged = filtered
     if upsert:
         newest: Dict[tuple, int] = {}
         for t, rows in tagged:

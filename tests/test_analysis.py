@@ -826,6 +826,8 @@ def test_hygiene_allowance_lists_start_empty():
     an explicit review."""
     from csvplus_tpu.analysis.astlint import (
         EAGER001_ALLOWED,
+        FAULT001_ALLOWED,
+        IO001_ALLOWED,
         THREAD001_ALLOWED,
         TRACE001_ALLOWED,
     )
@@ -833,6 +835,83 @@ def test_hygiene_allowance_lists_start_empty():
     assert TRACE001_ALLOWED == frozenset()
     assert EAGER001_ALLOWED == frozenset()
     assert THREAD001_ALLOWED == frozenset()
+    assert FAULT001_ALLOWED == frozenset()
+    assert IO001_ALLOWED == frozenset()
+
+
+# ---- IO001 (the durability boundary, ISSUE 10) -----------------------
+
+IO_BARE_WRITE = '''
+def save(path, doc):
+    with open(path, "w") as f:
+        f.write(doc)
+'''
+
+IO_FSYNC_OK = '''
+import os
+def save(path, doc):
+    with open(path, "w") as f:
+        f.write(doc)
+        f.flush()
+        os.fsync(f.fileno())
+'''
+
+IO_RENAME_OK = '''
+import os
+def save(path, doc):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(doc)
+    os.replace(tmp, path)
+'''
+
+
+def test_io001_fires_on_bare_storage_write():
+    (f,) = lint_source(IO_BARE_WRITE, "csvplus_tpu/storage/x.py")
+    assert f.code == "IO001" and "page cache" in f.message
+
+
+def test_io001_catches_mode_kwarg_and_append_mode():
+    src = IO_BARE_WRITE.replace('open(path, "w")', 'open(path, mode="ab")')
+    (f,) = lint_source(src, "csvplus_tpu/storage/x.py")
+    assert f.code == "IO001" and "'ab'" in f.message
+
+
+def test_io001_silent_on_durable_idioms_reads_and_other_modules():
+    assert lint_source(IO_FSYNC_OK, "csvplus_tpu/storage/x.py") == []
+    assert lint_source(IO_RENAME_OK, "csvplus_tpu/storage/x.py") == []
+    # reads never fire
+    assert (
+        lint_source(
+            'def load(p):\n    return open(p, "rb").read()\n',
+            "csvplus_tpu/storage/x.py",
+        )
+        == []
+    )
+    # outside storage/ the durability boundary does not apply
+    assert lint_source(IO_BARE_WRITE, "csvplus_tpu/serve/x.py") == []
+
+
+def test_io001_allowance_starts_empty():
+    from csvplus_tpu.analysis.astlint import IO001_ALLOWED
+
+    assert IO001_ALLOWED == frozenset()
+
+
+def test_thread001_covers_wal_and_tombstone_entries():
+    """ISSUE 10 extended the worker-entry list over the WAL/manifest
+    write path: an unlocked mutation reachable from ``append_record``
+    or ``delete`` is a THREAD001 finding."""
+    src = (
+        "class W:\n"
+        "    def append_record(self, lsn, doc):\n"
+        "        self.total = self.total + 1\n"
+    )
+    findings = lint_source(src, "wal.py")
+    assert findings and all(f.code == "THREAD001" for f in findings)
+    src2 = src.replace("append_record", "delete")
+    findings2 = lint_source(src2, "lsm.py")
+    assert findings2 and all(f.code == "THREAD001" for f in findings2)
 
 
 # ---- the `make analyze` snapshot -------------------------------------

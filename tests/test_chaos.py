@@ -537,3 +537,106 @@ def test_storage_compact_crash_served_reads_unaffected(served):
     assert index_checksums(mi.tiers().base) == index_checksums(
         rebuild_reference(mi)
     )
+
+
+# -- storage: WAL crash-restart matrix (ISSUE 10) ---------------------------
+#
+# Each window kills a subprocess child (tests/wal_crash_child.py) at one
+# fsync boundary of the durable write path, then recovers the directory
+# in THIS process and asserts the recovered checksums are bitwise-equal
+# to a fresh in-memory replay of exactly the ops the child acked.  Under
+# CSVPLUS_WAL_SYNC=always no acked op may ever be lost.
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CRASH_CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "wal_crash_child.py")
+
+def _load_crash_child():
+    # tests/ is not a package: load the shared op-script/reference
+    # helpers by path so child and parent can never drift
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "wal_crash_child", _CRASH_CHILD
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+#: fault-window matrix, defined next to the op script it indexes into
+WAL_CRASH_WINDOWS = _load_crash_child().CRASH_WINDOWS
+
+
+def _run_crash_child(tmp_path, fault, *, tear=False, mode="append"):
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CSVPLUS_WAL_SYNC"] = "always"
+    env["CSVPLUS_WAL_CHILD_MODE"] = mode
+    env.pop("CSVPLUS_FAULTS", None)
+    env.pop("CSVPLUS_WAL_CHILD_TEAR", None)
+    if fault is not None:
+        env["CSVPLUS_FAULTS"] = _json.dumps({"faults": [fault]})
+    if tear:
+        env["CSVPLUS_WAL_CHILD_TEAR"] = "1"
+    workdir = os.path.join(str(tmp_path), "idx")
+    acked_path = os.path.join(str(tmp_path), "acked.json")
+    proc = subprocess.run(
+        [_sys.executable, _CRASH_CHILD, workdir, acked_path],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode in (0, 3), proc.stderr
+    with open(acked_path) as f:
+        acked = _json.load(f)
+    return workdir, acked, proc.returncode
+
+
+@pytest.mark.parametrize("window", sorted(WAL_CRASH_WINDOWS))
+def test_wal_crash_restart_matrix(window, tmp_path):
+    from csvplus_tpu.storage import MutableIndex, index_checksums
+
+    fault, n_acked, n_replay = WAL_CRASH_WINDOWS[window]
+    workdir, acked, rc = _run_crash_child(
+        tmp_path, fault, tear=(window == "torn_tail")
+    )
+    # the armed windows crash the child; torn_tail exits clean
+    assert (rc == 3) == (fault is not None)
+    assert (acked["crashed"] is not None) == (fault is not None)
+    assert len(acked["ops"]) == n_acked
+    mi = MutableIndex.open(workdir)
+    assert mi.recovered_records == n_replay
+    if window == "torn_tail":
+        assert mi.recovery_info["truncated_bytes"] > 0
+    child = _load_crash_child()
+    ref = child.replay_reference(acked["ops"])
+    assert index_checksums(mi.to_index()) == index_checksums(ref.to_index())
+    # recovered index serves warm lookups with zero recompiles
+    probes = [("k003",), ("a05",), ("b02",), ("zz",)]
+    mi.find_rows_many(probes)
+    with RecompileWatch() as w:
+        got = mi.find_rows_many(probes)
+    w.assert_zero("post-recovery warm lookups")
+    assert [[dict(r) for r in b] for b in got] == [
+        [dict(r) for r in b] for b in ref.find_rows_many(probes)
+    ]
+
+
+def test_wal_crash_restart_upsert_mode(tmp_path):
+    """The torn-tail window again in upsert visibility: recovery parity
+    must hold when tombstones AND newest-wins shadowing interact."""
+    from csvplus_tpu.storage import MutableIndex, index_checksums
+
+    workdir, acked, rc = _run_crash_child(
+        tmp_path, None, tear=True, mode="upsert"
+    )
+    assert rc == 0 and len(acked["ops"]) == 7
+    mi = MutableIndex.open(workdir)
+    assert mi.mode == "upsert" and mi.recovered_records == 3
+    child = _load_crash_child()
+    ref = child.replay_reference(acked["ops"], mode="upsert")
+    assert index_checksums(mi.to_index()) == index_checksums(ref.to_index())

@@ -437,6 +437,58 @@ def test_serve_append_coalesces_and_is_visible(served):
     assert index_checksums(mi.to_index()) == index_checksums(rebuild_reference(mi))
 
 
+def test_served_durable_appends_ack_after_fsync(served, tmp_path):
+    """The ISSUE 10 durable-ack contract on the serving tier: an
+    append future resolving implies the cycle's WAL records were
+    already fsynced (``wal_sync`` runs before the callbacks fire), the
+    per-cycle WAL delta lands in the same ``by_index`` lock round, and
+    a recovered registration surfaces ``recovered_records``."""
+    from csvplus_tpu.row import Row
+    from csvplus_tpu.source import take_rows
+    from csvplus_tpu.storage import MutableIndex, index_checksums
+
+    idx, ids = served
+    d = str(tmp_path / "durable")
+    rows = [Row({"k": f"k{i % 17:03d}", "v": f"v{i}"}) for i in range(200)]
+    mi = MutableIndex.create(
+        take_rows(rows), ["k"], ingest_device="cpu",
+        directory=d, wal_sync="always",
+    )
+    with LookupServer(idx, indexes={"dur": mi}) as srv:
+        futs = [
+            srv.submit_append([{"k": f"srv{j}", "v": str(j)}], index="dur")
+            for j in range(5)
+        ]
+        assert [f.result(timeout=30.0) for f in futs] == [1] * 5
+        snap = srv.snapshot()
+    cell = snap["by_index"]["dur"]
+    assert cell["append_reqs"] == 5 and cell["rows_appended"] == 5
+    # one WAL record per dispatch cycle (appends coalesce), every one
+    # of them fsynced before its future resolved
+    assert cell["wal_records"] == mi.delta_count >= 1
+    assert cell["wal_fsyncs"] >= cell["wal_records"]
+    assert cell["wal_bytes"] > 0
+    assert cell["recovered_records"] == 0  # fresh index: nothing replayed
+
+    # everything acked above survives a cold reopen, bitwise
+    re1 = MutableIndex.open(d)
+    assert re1.recovered_records == cell["wal_records"]
+    assert index_checksums(re1.to_index()) == index_checksums(mi.to_index())
+    # registering the recovered index surfaces the replay count (the
+    # constructor path and live register() both report once)
+    with LookupServer(idx, indexes={"rec": re1}) as srv2:
+        srv2.register("rec2", re1)
+        snap2 = srv2.snapshot()
+    assert (
+        snap2["by_index"]["rec"]["recovered_records"]
+        == re1.recovered_records
+    )
+    assert (
+        snap2["by_index"]["rec2"]["recovered_records"]
+        == re1.recovered_records
+    )
+
+
 def test_served_reads_during_compaction_bitwise_equal(served):
     """The THREAD001 stress pattern extended to the write path: N
     submitter threads hammer a served MutableIndex while the background

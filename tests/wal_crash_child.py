@@ -1,0 +1,172 @@
+"""Subprocess child for the WAL crash-restart chaos matrix (ISSUE 10).
+
+Run as a script it builds a durable :class:`MutableIndex` in
+``argv[1]``, plays a fixed interleaved append/delete/compact op list,
+and records every op that was **acked** (the library call returned) to
+``argv[2]`` as JSON before exiting.  Crash windows are armed from the
+outside via ``CSVPLUS_FAULTS`` (parsed at import by
+``csvplus_tpu.resilience.faults``) so an injected fatal kills the op
+mid-flight exactly like a real ``kill -9`` between the fault point and
+the ack — the op is NOT recorded as acked, and the child exits with
+status 3 instead of 0.
+
+The parent (tests/test_chaos.py and chaos.py) then recovers the
+directory with ``MutableIndex.open`` and asserts the recovered
+checksums are bitwise-equal to :func:`replay_reference` — a fresh
+in-memory index fed only the acked stream.  Both sides import this
+module (by path, via importlib — tests/ is not a package) so the base
+rows, the op script, and the reference replay can never drift apart.
+
+Env knobs the parent sets:
+
+* ``CSVPLUS_WAL_SYNC`` — always ``always`` in the matrix: an acked op
+  must survive any crash.
+* ``CSVPLUS_FAULTS`` — the armed crash window (or unset for a clean
+  run).
+* ``CSVPLUS_WAL_CHILD_MODE`` — ``append`` (default) or ``upsert``.
+* ``CSVPLUS_WAL_CHILD_TEAR`` — ``1`` appends a garbage partial frame
+  to the active segment after all ops acked, simulating a kill mid
+  ``write(2)``: recovery must truncate it and lose nothing acked.
+"""
+
+import json
+import os
+import sys
+
+KEY_COLUMNS = ["k"]
+
+#: window name -> (fault spec or None, expected acked ops, expected WAL
+#: records replayed on recovery).  Shared by BOTH parents (pytest and
+#: chaos.py) so the matrix cannot drift.  Hit indices follow the op
+#: list's WAL-write budget documented on :func:`ops_script`.
+CRASH_WINDOWS = {
+    # killed at the top of a row-append's WAL write: op 2 never acked
+    "wal_append": (
+        {"site": "storage:wal-write", "at": [2], "error": "fatal"}, 2, 2),
+    # killed at the top of a tombstone's WAL write: op 3 never acked
+    "wal_delete": (
+        {"site": "storage:wal-write", "at": [3], "error": "fatal"}, 3, 3),
+    # killed during the checkpoint's segment seal: manifest still old,
+    # full WAL replay reconstructs every acked op
+    "segment_seal": (
+        {"site": "storage:wal-write", "at": [4], "error": "fatal"}, 4, 4),
+    # killed post-merge/pre-manifest-rename: old manifest + full WAL
+    "manifest_pre_rename": (
+        {"site": "storage:manifest-swap", "at": [0], "error": "fatal"}, 4, 4),
+    # killed post-rename/pre-WAL-truncate: new base, stale swept
+    "manifest_post_rename": (
+        {"site": "storage:manifest-swap", "at": [1], "error": "fatal"}, 4, 0),
+    # clean run, then a torn partial frame on the active segment (a
+    # kill mid write(2)): recovery truncates it, losing nothing acked
+    "torn_tail": (None, 7, 3),
+}
+
+
+def child_mode():
+    return os.environ.get("CSVPLUS_WAL_CHILD_MODE", "append")
+
+
+def base_rows():
+    """Deterministic base tier (shared with the parent's reference)."""
+    return [
+        {"k": f"k{i % 37:03d}", "v": f"v{i}", "w": f"w{i % 5}"}
+        for i in range(400)
+    ]
+
+
+def ops_script():
+    """The fixed logical op list.  ``compact`` is a marker, not a
+    logical op — compaction must never change the logical stream, so
+    the reference replay ignores it.
+
+    WAL-write hit budget (the fault windows key off these):
+    op0 rows -> hit 0, op1 del -> 1, op2 rows -> 2, op3 del -> 3,
+    compact seals the active segment -> hit 4, then op5 rows -> 5,
+    op6 del -> 6, op7 rows -> 7.
+    """
+    return [
+        {"op": "rows",
+         "rows": [{"k": f"a{j:02d}", "v": f"x{j}", "w": "aw"}
+                  for j in range(12)]},
+        {"op": "del", "key": ["k003"]},
+        {"op": "rows",
+         "rows": [{"k": "k003", "v": "reborn", "w": "rw"},
+                  {"k": "a05", "v": "dup", "w": "dw"}]},
+        {"op": "del", "key": ["a07"]},
+        {"op": "compact"},
+        {"op": "rows",
+         "rows": [{"k": f"b{j:02d}", "v": f"y{j}", "w": "bw"}
+                  for j in range(8)]},
+        {"op": "del", "key": ["b02"]},
+        {"op": "rows", "rows": [{"k": "b02", "v": "back", "w": "zw"}]},
+    ]
+
+
+def fresh_base():
+    from csvplus_tpu.index import create_index
+    from csvplus_tpu.row import Row
+    from csvplus_tpu.source import take_rows
+
+    return create_index(
+        take_rows([Row(r) for r in base_rows()]), KEY_COLUMNS
+    )
+
+
+def replay_reference(acked_ops, mode=None):
+    """A fresh MEMORY-ONLY index fed exactly the acked logical stream.
+    This is the truth the recovered directory must checksum-match."""
+    from csvplus_tpu.storage import MutableIndex
+
+    mi = MutableIndex(fresh_base(), mode=mode or child_mode())
+    for op in acked_ops:
+        if op["op"] == "rows":
+            mi.append_rows(op["rows"])
+        elif op["op"] == "del":
+            mi.delete(tuple(op["key"]))
+    return mi
+
+
+def main(workdir, acked_path):
+    from csvplus_tpu.storage import MutableIndex
+
+    acked = []
+    crashed = None
+    try:
+        mi = MutableIndex(
+            fresh_base(), mode=child_mode(), directory=workdir
+        )
+        for op in ops_script():
+            if op["op"] == "compact":
+                mi.compact_once()  # not a logical op: never acked
+            elif op["op"] == "rows":
+                mi.append_rows(op["rows"])
+                acked.append(op)
+            else:
+                mi.delete(tuple(op["key"]))
+                acked.append(op)
+    except Exception as exc:  # the armed crash window fires here
+        crashed = f"{type(exc).__name__}: {exc}"
+
+    if os.environ.get("CSVPLUS_WAL_CHILD_TEAR") == "1":
+        # simulate dying mid write(2): a frame header promising 64
+        # bytes with only garbage behind it, flushed to the active
+        # segment -- recovery must truncate this torn tail
+        segs = sorted(
+            n for n in os.listdir(workdir)
+            if n.startswith("wal-") and n.endswith(".log")
+        )
+        with open(os.path.join(workdir, segs[-1]), "ab") as f:
+            f.write(b"\x40\x00\x00\x00\xde\xad\xbe\xefTORN")
+            f.flush()
+            os.fsync(f.fileno())
+
+    with open(acked_path, "w") as f:
+        json.dump({"ops": acked, "crashed": crashed}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    # skip interpreter teardown: a crashed child should look crashed
+    os._exit(3 if crashed else 0)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2])
