@@ -107,12 +107,16 @@ bench-delta:
 	JAX_PLATFORMS=cpu python bench_delta.py
 
 # Durable mutable-index (WAL) bench: ack-after-fsync append throughput
-# (sync=always vs batch), 200K-row WAL-tail recovery, and lookup
-# latency with live tombstone tiers — with recovered-state checksum
-# parity and zero warm recompiles enforced in-bench.  One compact JSON
-# line last; exits nonzero on a >2x regression vs bench_wal_floor.json.
-# The checked-in record (BENCH_WAL_r11.json) is only (re)written when
-# CSVPLUS_BENCH_WAL_OUT is set.
+# (sync=always vs batch), 200K-row WAL-tail recovery, lookup latency
+# with live tombstone tiers, the read-amplification scenario (>=128
+# live delta tiers must stay within 3x of the fully-compacted floor —
+# the pruning contract), and read-amp-aware Compactor convergence —
+# with recovered-state checksum parity and zero warm recompiles
+# enforced in-bench.  CSVPLUS_MICRO_DIST=zipf skews the read-amp probe
+# stream.  One compact JSON line last; exits nonzero on a >2x
+# regression vs bench_wal_floor.json.  The checked-in record
+# (BENCH_WAL_r12.json) is only (re)written when CSVPLUS_BENCH_WAL_OUT
+# is set.
 bench-wal:
 	JAX_PLATFORMS=cpu python bench_wal.py
 

@@ -351,6 +351,17 @@ def main() -> int:
         "bench[delta]: warm post-compaction lookups recompiled nothing\n"
     )
 
+    # fence+filter pruning accounting for the whole run (ISSUE 11):
+    # cumulative tiers probed/pruned and the read-amp window the
+    # "readamp" Compactor policy schedules from
+    prune_stats = mi.snapshot()["prune"]
+    sys.stderr.write(
+        f"bench[delta]: prune enabled={prune_stats.get('enabled')}"
+        f" tier_probes={prune_stats.get('tier_probes')}"
+        f" tiers_pruned={prune_stats.get('tiers_pruned')}"
+        f" mean_tiers_probed={prune_stats.get('mean_tiers_probed')}\n"
+    )
+
     # -- record ------------------------------------------------------------
     record = {
         "metric": "delta_append_rows_per_sec",
@@ -365,6 +376,7 @@ def main() -> int:
         "lookup_p99_ms_0_deltas": scenarios["lookup_0_deltas"]["p99_ms"],
         "lookup_p99_ms_16_deltas": scenarios["lookup_16_deltas"]["p99_ms"],
         "compact_seconds": cp_s["compact_seconds"],
+        "prune": prune_stats,
         "scenarios": scenarios,
     }
     try:

@@ -16,6 +16,19 @@ numbers docs/STORAGE.md promises for it:
 - lookup p50/p99       per-probe ``find_rows`` latency with live
                        tombstone tiers on the read path (the shadowing
                        masks are on the hot path; they must stay cheap)
+- read-amp floor       per-probe ``find_rows`` throughput at >= 128
+                       LIVE tiers (row deltas + tombstone tiers) with
+                       host-side fence/filter pruning (ISSUE 11) vs the
+                       same probes against the fully compacted base —
+                       the r11 cliff was 47x; the pruned ratio must
+                       stay within 3x (asserted in-bench), results
+                       bitwise-equal to the compacted truth, parity
+                       held at EVERY compaction step, zero warm
+                       recompiles
+- readamp compactor    a sustained append+lookup mix under the
+                       ``policy="readamp"`` Compactor: the observed
+                       mean tiers-probed must fall under the target
+                       with NO manual compaction call (asserted)
 
 The hard contract is enforced IN-BENCH: the recovered index must
 checksum-match the live one (bitwise, ``index_checksums``) and the
@@ -35,7 +48,12 @@ _APPEND_ROWS (rows per append batch, default 2000), _RECOVERY_ROWS
 (WAL-tail rows for the recovery scenario, default 200K), _LOOKUPS
 (probes for the latency scenario, default 1000), _OUT (artifact path;
 no file by default so a gate run cannot overwrite the checked-in
-record).  Seeds are fixed: same shape -> same probe sequence.
+record).  ``CSVPLUS_MICRO_DIST=zipf`` switches the read-amp tier's
+probe draws to the shared Zipf hot-key distribution
+(``bench.zipf_probe_values``, the same helper ``make bench-serve``
+uses); the default stays uniform so the gated floor is
+apples-to-apples with the checked-in record.  Seeds are fixed: same
+shape -> same probe sequence.
 """
 
 from __future__ import annotations
@@ -212,6 +230,187 @@ def _zero_recompile_gate(mi, probes) -> dict:
     return {"observable": bool(w.observable()), "recompiles": 0}
 
 
+def _readamp_probe_values(ids, n_probes: int):
+    """The read-amp tier's probe draw: uniform by default, the shared
+    Zipf hot-key distribution under CSVPLUS_MICRO_DIST=zipf."""
+    dist = os.environ.get("CSVPLUS_MICRO_DIST", "uniform")
+    if dist == "zipf":
+        from bench import zipf_probe_values
+
+        return dist, [f"c{int(v)}" for v in zipf_probe_values(ids, n_probes)]
+    return dist, _uniform_probes(ids, n_probes, seed=11)
+
+
+def _timed_single_probes(mi, probes) -> float:
+    """Per-probe find_rows loop (the serving single-probe shape the r11
+    cliff was measured on), returning lookups/s."""
+    t0 = time.perf_counter()
+    for p in probes:
+        mi.find_rows((p,))
+    return len(probes) / (time.perf_counter() - t0)
+
+
+def _readamp_scenario(directory, src, ids, n_probes: int) -> dict:
+    """The ISSUE 11 tentpole number: lookup throughput at >=128 live
+    tiers (row deltas AND tombstone tiers) with host fence/filter
+    pruning, vs the SAME probes against the fully compacted base.
+
+    Hard contracts, asserted in-bench:
+
+    - pruned layered results are bitwise-equal to the compacted truth
+      (per-probe row compare) and checksum-parity holds vs the
+      from-scratch logical rebuild;
+    - the ``to_index`` checksum is invariant at EVERY leveled
+      compaction step on the way down;
+    - warm pruned lookups recompile nothing;
+    - layered throughput stays within 3x of the compacted floor (the
+      r11 cliff was 47x).
+    """
+    from csvplus_tpu.obs.recompile import RecompileWatch
+    from csvplus_tpu.storage import (
+        MutableIndex,
+        index_checksums,
+        rebuild_reference,
+    )
+
+    mi = MutableIndex.create(
+        src, ["cust_id"], mode="append", ingest_device="cpu",
+        directory=directory, wal_sync="batch",
+    )
+    # 120 row tiers + 20 tombstone tiers = 140 live tiers (>= 128)
+    for b in range(120):
+        mi.append_rows(_delta_rows(120, 500_000 + b * 120))
+        if b % 6 == 0:
+            mi.delete((f"c{int(ids[(b * 131) % len(ids)])}",))
+    mi.wal_sync()
+    tiers_live = mi.delta_count
+    if tiers_live < 128:
+        raise AssertionError(
+            f"bench[wal] shape bug: only {tiers_live} live tiers"
+        )
+
+    dist, probes = _readamp_probe_values(ids, n_probes)
+    norm = [(p,) for p in probes]
+    mi.find_rows_many(norm[:64])  # warm off the clock
+    mi.readamp.take_window()  # report the mean over the timed loop only
+    layered_rate = _timed_single_probes(mi, probes)
+    mean_tiers = mi.readamp.take_window()
+    layered_rows = [[dict(r) for r in mi.find_rows((p,))] for p in probes]
+    with RecompileWatch() as w:
+        mi.find_rows_many(norm[:256])
+    w.assert_zero("bench-wal warm pruned lookups")
+    frozen = index_checksums(mi.to_index())
+    prune_stats = mi.snapshot()["prune"]
+
+    # compact to the floor, holding the checksum at every step
+    steps = 0
+    while True:
+        if mi.compact_step() is None:
+            break
+        steps += 1
+        if index_checksums(mi.to_index()) != frozen:
+            raise AssertionError(
+                f"bench[wal] PARITY BREACH at compaction step {steps}"
+            )
+    mi.compact_once()
+    if index_checksums(mi.to_index()) != frozen:
+        raise AssertionError("bench[wal] PARITY BREACH at full compaction")
+    if index_checksums(mi.to_index()) != index_checksums(
+        rebuild_reference(mi)
+    ):
+        raise AssertionError(
+            "bench[wal] PARITY BREACH vs from-scratch logical rebuild"
+        )
+    compacted_rows = [[dict(r) for r in mi.find_rows((p,))] for p in probes]
+    if layered_rows != compacted_rows:
+        raise AssertionError(
+            "bench[wal] PRUNE BREACH: layered pruned results differ from"
+            " the compacted truth"
+        )
+    mi.find_rows_many(norm[:64])
+    floor_rate = _timed_single_probes(mi, probes)
+    ratio = floor_rate / layered_rate
+    if ratio > 3.0:
+        raise AssertionError(
+            f"bench[wal] READ-AMP BREACH: compacted/layered throughput"
+            f" ratio {ratio:.2f}x exceeds the 3x bound"
+            f" ({layered_rate:,.0f}/s layered vs {floor_rate:,.0f}/s"
+            f" compacted at {tiers_live} tiers)"
+        )
+    return {
+        "dist": dist,
+        "tiers_live": tiers_live,
+        "tombstone_tiers": 20,
+        "n": len(probes),
+        "lookups_per_sec_layered": round(layered_rate, 1),
+        "lookups_per_sec_compacted": round(floor_rate, 1),
+        "compacted_over_layered": round(ratio, 3),
+        "mean_tiers_probed": (
+            round(mean_tiers, 3) if mean_tiers is not None else None
+        ),
+        "compaction_steps": steps,
+        "prune": prune_stats,
+    }
+
+
+def _readamp_compactor_scenario(timeout_s: float = 60.0) -> dict:
+    """Sustained append+lookup mix under the ``readamp`` Compactor
+    policy, NO manual compaction: the policy must observe the window
+    mean, compact, and drive it under the target.  A non-convergence is
+    a raise, not a recorded miss — the scheduler IS the feature."""
+    from csvplus_tpu.row import Row
+    from csvplus_tpu.source import take_rows
+    from csvplus_tpu.storage import Compactor, MutableIndex
+
+    rows = [Row({"cust_id": f"h{i % 11}", "v": str(i)}) for i in range(256)]
+    mi = MutableIndex.create(
+        take_rows(rows), ["cust_id"], mode="append", ingest_device="cpu",
+    )
+    # the hot key lives in EVERY tier, so pruning cannot mask the
+    # amplification — only the compactor can fix it
+    for b in range(32):
+        mi.append_rows([{"cust_id": "h0", "v": f"hot{b}"}])
+    probes = [("h0",)] * 8
+    mi.find_rows_many(probes)
+    pre_mean = mi.readamp.take_window()
+    target = 4.0
+    c = Compactor(
+        mi, min_deltas=1, interval_s=0.005, policy="readamp",
+        readamp_target=target,
+    )
+    t0 = time.perf_counter()
+    converged_s = None
+    with c:
+        while time.perf_counter() - t0 < timeout_s:
+            mi.append_rows([{"cust_id": "h0", "v": "more"}])
+            mi.find_rows_many(probes)
+            snap = c.snapshot()
+            if (
+                snap["last_readamp"] is not None
+                and snap["last_readamp"] <= target
+                and snap["compactions"] >= 1
+            ):
+                converged_s = time.perf_counter() - t0
+                break
+            time.sleep(0.01)
+    if converged_s is None:
+        raise AssertionError(
+            f"bench[wal] READ-AMP BREACH: readamp compactor never"
+            f" converged under target {target} in {timeout_s}s:"
+            f" {c.snapshot()}"
+        )
+    snap = c.snapshot()
+    return {
+        "policy": "readamp",
+        "target": target,
+        "pre_mean_tiers_probed": round(pre_mean, 2),
+        "converged_seconds": round(converged_s, 3),
+        "final_mean_tiers_probed": snap["last_readamp"],
+        "compactions": snap["compactions"],
+        "deltas_live_after": mi.delta_count,
+    }
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -288,6 +487,29 @@ def main() -> int:
         sys.stderr.write(
             "bench[wal]: warm recovered-index lookups recompiled nothing\n"
         )
+
+        # -- read amplification at >=128 live tiers (ISSUE 11) -------------
+        src, ids = _base_source(n)
+        d = os.path.join(tmp_root, "readamp")
+        scenarios["readamp"] = _readamp_scenario(d, src, ids, n_lookups)
+        ra = scenarios["readamp"]
+        sys.stderr.write(
+            f"bench[wal]: read-amp dist={ra['dist']}"
+            f" {ra['lookups_per_sec_layered']:,.0f}/s at"
+            f" {ra['tiers_live']} live tiers vs"
+            f" {ra['lookups_per_sec_compacted']:,.0f}/s compacted"
+            f" ({ra['compacted_over_layered']}x, mean"
+            f" {ra['mean_tiers_probed']} tiers probed)\n"
+        )
+        scenarios["readamp_compactor"] = _readamp_compactor_scenario()
+        rc = scenarios["readamp_compactor"]
+        sys.stderr.write(
+            f"bench[wal]: readamp compactor converged"
+            f" {rc['pre_mean_tiers_probed']} ->"
+            f" {rc['final_mean_tiers_probed']} mean tiers probed in"
+            f" {rc['converged_seconds']}s ({rc['compactions']}"
+            f" compactions, no manual compact)\n"
+        )
     finally:
         shutil.rmtree(tmp_root, ignore_errors=True)
 
@@ -307,6 +529,9 @@ def main() -> int:
         "recovery_seconds": rec["seconds"],
         "lookups_per_sec_tombstones": lk["lookups_per_sec"],
         "lookup_p50_ms_tombstones": lk["p50_ms"],
+        "lookups_per_sec_readamp": ra["lookups_per_sec_layered"],
+        "readamp_tiers_live": ra["tiers_live"],
+        "readamp_compacted_over_layered": ra["compacted_over_layered"],
         "scenarios": scenarios,
     }
     try:
@@ -336,6 +561,7 @@ def main() -> int:
         ("wal_append_rows_per_sec_batch", batch_rate),
         ("recovery_rows_per_sec", rec["rows_per_sec"]),
         ("lookups_per_sec_tombstones", lk["lookups_per_sec"]),
+        ("lookups_per_sec_readamp", ra["lookups_per_sec_layered"]),
     ):
         floor = float(floors.get(key, 0.0) or 0.0)
         if floor and got < floor / 2:
@@ -355,6 +581,8 @@ def main() -> int:
             "recovery_rows", "host_cpus", "wal_append_rows_per_sec_batch",
             "recovery_rows_per_sec", "recovery_seconds",
             "lookups_per_sec_tombstones", "lookup_p50_ms_tombstones",
+            "lookups_per_sec_readamp", "readamp_tiers_live",
+            "readamp_compacted_over_layered",
         )
         if k in record
     }

@@ -399,6 +399,15 @@ _WORKER_ENTRY_NAMES = (
     "seal_active",
     "drop_applied",
     "on_recovered",
+    # csvplus_tpu/storage read-pruning entry points (ISSUE 11): the
+    # multi-tier probe path itself (serving threads call bounds_many
+    # concurrently with writers swapping tier sets — its lazy builds
+    # must stay lock-guarded), and the read-amplification tracker's
+    # recorder/window mutators (hit from every reader thread and the
+    # readamp-policy compactor loop).
+    "bounds_many",
+    "on_lookup_batch",
+    "take_window",
 )
 
 _EAGER_TRANSFORM_OPS = frozenset(

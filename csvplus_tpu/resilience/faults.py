@@ -37,6 +37,12 @@ through the tree at existing span/stage boundaries:
   post-merge/pre-rename window (recovery must use the OLD base + full
   WAL), hit 1 the post-rename/pre-WAL-drop window (new base, stale
   segments swept).  Both recover checksum-equal to the acked stream.
+* ``storage:prune-sidecar`` — brackets the checkpoint's fence/filter
+  sidecar write (ISSUE 11): hit 0 fires before the sidecar exists,
+  hit 1 after it exists but before the manifest references it.  Either
+  crash leaves the OLD manifest (and old sidecar) live; recovery
+  reloads or rebuilds summaries and sweeps the orphans — pruning state
+  can never diverge from the base it describes.
 
 DISCIPLINE: the disarmed path is one module-global ``None`` check per
 site (:func:`inject`), the same budget rule as the tracing subsystem's
@@ -102,6 +108,7 @@ SITES = (
     "storage:compact",
     "storage:wal-write",
     "storage:manifest-swap",
+    "storage:prune-sidecar",
 )
 
 

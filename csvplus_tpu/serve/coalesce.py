@@ -532,20 +532,23 @@ class LookupServer:
         def primary_pass():
             # find_rows_many decomposed so the coalesced batch's two
             # phases carry their own timestamps; each request's trace
-            # gets both as batch-shared children of its dispatch span
+            # gets both as batch-shared children of its dispatch span.
+            # A MutableIndex's bounds carry read-amplification counters
+            # (tiers probed / pruned); a plain Index returns a list —
+            # getattr reads None and the metrics cell stays untouched.
             t_a = time.perf_counter()
             faults.inject("serve:bounds")
             bounds = reg.impl.bounds_many(probes)
             t_b = time.perf_counter()
             groups = reg.impl.rows_for_bounds(bounds)
-            return t_a, t_b, time.perf_counter(), groups
+            return t_a, t_b, time.perf_counter(), groups, bounds
 
         def fallback_pass():
             t_a = time.perf_counter()
             bounds = reg.oracle.bounds_many(probes)
             t_b = time.perf_counter()
             groups = reg.oracle.rows_for_bounds(bounds)
-            return t_a, t_b, time.perf_counter(), groups
+            return t_a, t_b, time.perf_counter(), groups, bounds
 
         def on_retry(attempt, err):
             self.metrics.on_retry()
@@ -554,10 +557,10 @@ class LookupServer:
         degraded = self.breaker.route() == "fallback"
         try:
             if degraded:
-                t_a, t_b, t_c, groups = fallback_pass()
+                t_a, t_b, t_c, groups, bounds = fallback_pass()
             else:
                 try:
-                    t_a, t_b, t_c, groups = call_with_retry(
+                    t_a, t_b, t_c, groups, bounds = call_with_retry(
                         primary_pass,
                         policy=self.retry_policy,
                         time_left=time_left,
@@ -573,7 +576,7 @@ class LookupServer:
                     # serve the batch from the host oracle instead of
                     # failing it back to callers
                     degraded = True
-                    t_a, t_b, t_c, groups = fallback_pass()
+                    t_a, t_b, t_c, groups, bounds = fallback_pass()
         except Exception as err:
             for req in lookups:
                 self._complete(req, None, err, samples, batch_n=len(lookups))
@@ -581,7 +584,12 @@ class LookupServer:
             return
         if degraded:
             self.metrics.on_degraded(len(lookups))
-        self.metrics.on_index_batch(reg.name, lookups=len(lookups))
+        self.metrics.on_index_batch(
+            reg.name,
+            lookups=len(lookups),
+            tiers_probed=getattr(bounds, "tiers_probed", None),
+            tiers_pruned=getattr(bounds, "tiers_pruned", None),
+        )
         phases = (
             ("serve:bounds", t_a, t_b),
             ("serve:gather-decode", t_b, t_c),

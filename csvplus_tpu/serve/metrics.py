@@ -89,6 +89,12 @@ def _new_index_cell() -> Dict[str, object]:
         "lookups": 0,
         "append_reqs": 0,
         "rows_appended": 0,
+        # read-amplification observed by the serving tier: per-tier
+        # bounds passes paid / skipped via fence+filter pruning
+        # (MutableIndex.bounds_many counters, zero forever on
+        # immutable indexes)
+        "tiers_probed": 0,
+        "tiers_pruned": 0,
         "deltas_live": 0,
         "compactions": 0,
         "compacted_deltas": 0,
@@ -235,6 +241,8 @@ class ServingMetrics:
         lookups: int = 0,
         append_reqs: int = 0,
         rows_appended: int = 0,
+        tiers_probed: Optional[int] = None,
+        tiers_pruned: Optional[int] = None,
         deltas_live: Optional[int] = None,
         wal: Optional[Dict[str, int]] = None,
     ) -> None:
@@ -243,12 +251,18 @@ class ServingMetrics:
         cycle's durable-ack delta (``wal_sync()``'s return value:
         records/bytes/fsyncs made durable before the cycle's append
         futures completed); folding it here keeps the r08 one-round
-        rule even on durable indexes."""
+        rule even on durable indexes.  ``tiers_probed``/``tiers_pruned``
+        are the cycle's read-amplification counters off the same
+        batch's ``MultiBounds`` — same single round."""
         with self._lock:
             cell = self._by_index.setdefault(name, _new_index_cell())
             cell["lookups"] += lookups
             cell["append_reqs"] += append_reqs
             cell["rows_appended"] += rows_appended
+            if tiers_probed is not None:
+                cell["tiers_probed"] += int(tiers_probed)
+            if tiers_pruned is not None:
+                cell["tiers_pruned"] += int(tiers_pruned)
             if deltas_live is not None:
                 cell["deltas_live"] = int(deltas_live)
             if wal is not None:

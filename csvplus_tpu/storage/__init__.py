@@ -13,8 +13,18 @@ modes, lookups probe base+deltas through the same multi-tier
 **compactor** folds tiers with a cache-conscious multi-way merge that
 swaps in atomically under readers (epoch-snapshotted tier sets; the
 probe hot path takes no lock) — either everything into the base each
-pass, or level-by-level under the size-ratio policy for bounded write
-amplification.
+pass, level-by-level under the size-ratio policy for bounded write
+amplification, or from observed read amplification (``readamp``) so
+compaction work tracks what readers actually pay.
+
+Read pruning (ISSUE 11): every sealed row tier carries min/max key
+fences and a seeded Bloom fingerprint filter
+(:mod:`~csvplus_tpu.storage.prune`); lookups consult them on the host
+to shortlist tiers BEFORE any per-tier bounds pass, so a probe against
+a hundred live tiers touches the 1-3 that can contain the key.
+Pruning is one-sided — bitwise-identical results with it on or off —
+and checkpointed bases persist their summaries as ``prune-*.flt``
+sidecars so recovery never rescans.
 
 Durability: construct with ``directory=`` (or recover with
 ``MutableIndex.open``) and every append/delete writes one checksummed
@@ -48,11 +58,13 @@ from .compact import Compactor, merge_tiers, merge_units, plan_compaction
 from .lsm import (
     DeltaTier,
     MutableIndex,
+    ReadAmpTracker,
     TierSet,
     index_checksums,
     rebuild_reference,
 )
 from .manifest import MANIFEST_NAME, ManifestError, read_manifest, write_manifest
+from .prune import PruneDirectory, TierPruner, build_pruner, load_pruner, write_pruner
 from .wal import Wal, WalError, wal_sync_mode
 
 __all__ = [
@@ -61,10 +73,15 @@ __all__ = [
     "MANIFEST_NAME",
     "ManifestError",
     "MutableIndex",
+    "PruneDirectory",
+    "ReadAmpTracker",
+    "TierPruner",
     "TierSet",
     "Wal",
     "WalError",
+    "build_pruner",
     "index_checksums",
+    "load_pruner",
     "merge_tiers",
     "merge_units",
     "plan_compaction",

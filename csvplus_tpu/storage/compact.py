@@ -40,6 +40,7 @@ snapshot-swap + searchsorted path — no new kernels, no recompiles.
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
@@ -421,7 +422,16 @@ class Compactor:
     ``policy="full"`` folds every delta into the base each pass (the
     r10 behaviour); ``policy="leveled"`` runs the size-ratio policy —
     :meth:`MutableIndex.compact_step` — for bounded write amplification
-    under sustained appends.
+    under sustained appends; ``policy="readamp"`` (ISSUE 11) schedules
+    from OBSERVED read amplification: each pass drains the index's
+    :class:`~csvplus_tpu.storage.lsm.ReadAmpTracker` window and
+    compacts only while the mean tiers-probed-per-lookup exceeds
+    ``readamp_target`` (default ``CSVPLUS_LSM_READAMP_TARGET`` = 4.0)
+    — a leveled step first, escalating to a full fold when the ratio
+    policy finds nothing due but lookups still pay too many tiers.
+    With fences+filters pruning most tiers, a cold tier that no lookup
+    ever touches never forces a merge — compaction work tracks what
+    readers actually pay, not raw tier counts.
 
     ``_compact_loop`` is a THREAD001 worker entry: all Compactor state
     mutates under ``self._lock``; the index's own swap discipline lives
@@ -441,16 +451,28 @@ class Compactor:
         index_name: str = "default",
         policy: str = "full",
         ratio: Optional[int] = None,
+        readamp_target: Optional[float] = None,
     ):
         if min_deltas < 1:
             raise ValueError("min_deltas must be >= 1")
-        if policy not in ("full", "leveled"):
+        if policy not in ("full", "leveled", "readamp"):
             raise ValueError(f"unknown Compactor policy {policy!r}")
         self.index = index
         self.min_deltas = int(min_deltas)
         self.interval_s = float(interval_s)
         self.policy = policy
         self.ratio = ratio
+        if readamp_target is None:
+            try:
+                readamp_target = float(
+                    os.environ.get("CSVPLUS_LSM_READAMP_TARGET", "")
+                )
+            except ValueError:
+                readamp_target = 4.0
+        self.readamp_target = float(readamp_target)
+        if self.readamp_target < 1.0:
+            raise ValueError("readamp_target must be >= 1.0")
+        self.last_readamp: Optional[float] = None
         self._metrics = metrics
         self._name = index_name
         self._lock = threading.Lock()
@@ -491,7 +513,9 @@ class Compactor:
     def run_once(self) -> Optional[Dict[str, object]]:
         """One compaction pass (also the unit tests' direct entry).
         Exceptions propagate to the caller; the loop catches them."""
-        if self.policy == "leveled":
+        if self.policy == "readamp":
+            stats = self._readamp_pass()
+        elif self.policy == "leveled":
             stats = self.index.compact_step(ratio=self.ratio)
         else:
             stats = self.index.compact_once()
@@ -508,6 +532,23 @@ class Compactor:
                     float(stats["seconds"]),
                     deltas_live=self.index.delta_count,
                 )
+        return stats
+
+    def _readamp_pass(self) -> Optional[Dict[str, object]]:
+        """One read-amp-driven pass: drain the observation window; when
+        the mean tiers-probed exceeds the target, run one leveled step
+        (bounded write amplification), escalating to a full fold when
+        the size-ratio policy has nothing due but readers still pay.
+        No lookups since the last pass -> no evidence -> no work."""
+        mean = self.index.readamp.take_window()
+        if mean is not None:
+            with self._lock:
+                self.last_readamp = mean
+        if mean is None or mean <= self.readamp_target:
+            return None
+        stats = self.index.compact_step(ratio=self.ratio)
+        if stats is None:
+            stats = self.index.compact_once()
         return stats
 
     def _compact_loop(self) -> None:
@@ -533,6 +574,12 @@ class Compactor:
         with self._lock:
             return {
                 "policy": self.policy,
+                "readamp_target": self.readamp_target,
+                "last_readamp": (
+                    round(self.last_readamp, 3)
+                    if self.last_readamp is not None
+                    else None
+                ),
                 "compactions": self.compactions,
                 "failures": self.failures,
                 "last_error": (

@@ -62,9 +62,22 @@ build a NEW TierSet and swap it under ``self._lock``.  The compactor
 merges OUTSIDE the lock against its pinned snapshot and swaps only the
 merged range, so appends landing mid-merge survive as the new tier
 list's tail.  ``append_rows``, ``delete``, ``compact_once``,
-``compact_step`` and ``wal_sync`` are THREAD001 worker entries
+``compact_step``, ``wal_sync``, ``bounds_many`` and the
+:class:`ReadAmpTracker` entries are THREAD001 worker entries
 (analysis/astlint.py): every shared-state mutation below them must sit
 under a lock, with zero allowances.
+
+Read pruning (ISSUE 11)
+-----------------------
+
+Each sealed row tier carries a :class:`~csvplus_tpu.storage.prune.TierPruner`
+(min/max key fences + a seeded Bloom filter); every :meth:`bounds_many`
+batch consults the TierSet's :class:`~csvplus_tpu.storage.prune.PruneDirectory`
+on the host to shortlist tiers BEFORE any per-tier bounds pass.
+Pruning is one-sided, so results are bitwise-identical with it on or
+off (``CSVPLUS_LSM_PRUNE=0`` disables it).  Checkpoints persist the
+merged base's summaries as a ``prune-%08d.flt`` sidecar named in the
+manifest, so recovery reloads them without a rescan.
 """
 
 from __future__ import annotations
@@ -74,16 +87,27 @@ import threading
 import time
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from ..index import Index, create_index, load_index
 from ..resilience import faults
 from ..row import Row
 from ..source import take_rows
 from ..utils.env import env_int
 from ..utils.observe import telemetry
+from .prune import (
+    PruneDirectory,
+    TierPruner,
+    build_pruner,
+    load_pruner,
+    prune_enabled,
+    write_pruner,
+)
 
 __all__ = [
     "DeltaTier",
     "MutableIndex",
+    "ReadAmpTracker",
     "TierSet",
     "index_checksums",
     "rebuild_reference",
@@ -102,16 +126,23 @@ class DeltaTier:
     rows — after a partial merge a tier carries both, and its rows were
     appended after its deletes)."""
 
-    __slots__ = ("seq", "index", "tombs", "tomb_set")
+    __slots__ = ("seq", "index", "tombs", "tomb_set", "pruner")
 
     def __init__(self, seq: int, index: Optional[Index],
-                 tombs: Sequence[Tuple[str, ...]] = ()):
+                 tombs: Sequence[Tuple[str, ...]] = (),
+                 pruner: Optional[TierPruner] = None):
         self.seq = seq
         self.index = index
         self.tombs: Tuple[Tuple[str, ...], ...] = tuple(
             sorted(set(tuple(k) for k in tombs))
         )
         self.tomb_set: FrozenSet[Tuple[str, ...]] = frozenset(self.tombs)
+        # fences + fingerprint filter for this tier's rows (prune.py);
+        # None for pure tombstone tiers or when pruning is disabled.
+        # Tombstones themselves are NEVER pruned — shadowing reads the
+        # tomb_set directly, so a pruned row tier cannot un-shadow
+        # anything.
+        self.pruner = pruner
 
     @property
     def nrows(self) -> int:
@@ -132,19 +163,54 @@ class TierSet:
     correct) for as long as any reader holds them.
     """
 
-    __slots__ = ("epoch", "base", "deltas")
+    __slots__ = ("epoch", "base", "deltas", "base_pruner", "prune_dir",
+                 "row_tiers", "positions", "tombs_by_age", "tomb_newest")
 
-    def __init__(self, epoch: int, base: Index, deltas: Tuple[DeltaTier, ...]):
+    def __init__(self, epoch: int, base: Index, deltas: Tuple[DeltaTier, ...],
+                 base_pruner: Optional[TierPruner] = None):
         self.epoch = epoch
         self.base = base
         self.deltas = deltas
+        self.base_pruner = base_pruner
+        # read-path projections, computed ONCE per swap: rebuilding
+        # these per lookup costs one Python pass over every delta —
+        # measurable at 100+ tiers even when pruning skips them all
+        self.row_tiers = (base,) + tuple(
+            d.index for d in deltas if d.index is not None
+        )
+        self.positions = (0,) + tuple(
+            p + 1 for p, d in enumerate(deltas) if d.index is not None
+        )
+        self.tombs_by_age = tuple(
+            (p + 1, d.tomb_set) for p, d in enumerate(deltas) if d.tombs
+        )
+        # merged newest-tombstone-per-key map: the full-width probe
+        # shadow test becomes one dict hit instead of a membership test
+        # against every tombstone tier
+        newest: Dict[Tuple[str, ...], int] = {}
+        for p, tset in self.tombs_by_age:
+            for key in tset:
+                newest[key] = p  # tombs_by_age ascends: last write wins
+        self.tomb_newest = newest
+        # the read path's prune directory is built EAGERLY here, under
+        # the writer's lock (every TierSet is constructed by a writer),
+        # so probes touch only immutable state — the THREAD001 rule.
+        # Pruning engages only when the base AND every row tier carry a
+        # pruner; a single pruner-less row tier disables it (correct,
+        # just slower — never wrong).
+        pd = None
+        if base_pruner is not None:
+            prs = [base_pruner] + [
+                d.pruner for d in deltas if d.index is not None
+            ]
+            if all(p is not None for p in prs):
+                pd = PruneDirectory(prs, len(base._impl.columns))
+        self.prune_dir = pd
 
     def indexes(self) -> Tuple[Index, ...]:
         """All ROW tiers oldest→newest (base first; pure tombstone
         tiers carry no rows and are skipped)."""
-        return (self.base,) + tuple(
-            d.index for d in self.deltas if d.index is not None
-        )
+        return self.row_tiers
 
 
 class MultiBounds:
@@ -158,7 +224,8 @@ class MultiBounds:
     (base = 0, delta *i* = *i*+1) so tombstone shadowing can compare
     ages across row and tombstone tiers."""
 
-    __slots__ = ("tiers", "per_tier", "probes", "row_tiers", "positions")
+    __slots__ = ("tiers", "per_tier", "probes", "row_tiers", "positions",
+                 "tiers_probed", "tiers_pruned")
 
     def __init__(self, tiers: TierSet, per_tier, probes, row_tiers, positions):
         self.tiers = tiers
@@ -166,6 +233,58 @@ class MultiBounds:
         self.probes = probes
         self.row_tiers = row_tiers
         self.positions = positions
+        # (probe, tier) bounds passes actually paid / skipped via
+        # fences+filters for this batch — the serving tier forwards
+        # these into its per-index metrics cells
+        self.tiers_probed = 0
+        self.tiers_pruned = 0
+
+
+class ReadAmpTracker:
+    """Observed read amplification: (probe, tier) bounds passes per
+    lookup, with a resettable window the read-amp-aware Compactor
+    polls.  ``on_lookup_batch`` and ``take_window`` are THREAD001
+    worker entries — all state mutates under ``_lock`` (one lock round
+    per probe BATCH, off the per-probe fast path)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._probes_total = 0
+        self._tier_probes_total = 0
+        self._pruned_total = 0
+        self._win_probes = 0
+        self._win_tier_probes = 0
+
+    def on_lookup_batch(self, n_probes: int, tiers_probed: int,
+                        tiers_pruned: int) -> None:
+        with self._lock:
+            self._probes_total += n_probes
+            self._tier_probes_total += tiers_probed
+            self._pruned_total += tiers_pruned
+            self._win_probes += n_probes
+            self._win_tier_probes += tiers_probed
+
+    def take_window(self) -> Optional[float]:
+        """Mean tiers probed per lookup since the last call (None when
+        no lookups landed) — and reset the window."""
+        with self._lock:
+            p = self._win_probes
+            tp = self._win_tier_probes
+            self._win_probes = 0
+            self._win_tier_probes = 0
+        return (tp / p) if p else None
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            p = self._probes_total
+            tp = self._tier_probes_total
+            pr = self._pruned_total
+        return {
+            "probes": p,
+            "tier_probes": tp,
+            "tiers_pruned": pr,
+            "mean_tiers_probed": round(tp / p, 3) if p else None,
+        }
 
 
 def tier_rows(impl) -> List[Row]:
@@ -281,7 +400,27 @@ class MutableIndex:
         # serializes whole compaction passes (snapshot -> merge -> swap):
         # the swap-range invariant assumes at most one in-flight merge
         self._compact_lock = threading.Lock()
-        self._tiers = TierSet(0, base, ())
+        # fences + fingerprint filters (prune.py): CSVPLUS_LSM_PRUNE
+        # gates the whole subsystem.  A recovered index reloads the
+        # checkpointed base's sidecar (named in the manifest) instead
+        # of rescanning; a missing or corrupt sidecar degrades to the
+        # rebuild scan — slower startup, never wrong answers.
+        self._prune = prune_enabled()
+        self._readamp = ReadAmpTracker()
+        base_pruner: Optional[TierPruner] = None
+        if self._prune:
+            side = None if _manifest is None else _manifest.get("prune")
+            if directory is not None and side:
+                try:
+                    base_pruner = load_pruner(
+                        os.path.join(directory, str(side)),
+                        expect_nrows=len(base._impl),
+                    )
+                except Exception:
+                    base_pruner = None  # rebuild by scan below
+            if base_pruner is None:
+                base_pruner = build_pruner(base._impl, self._columns)
+        self._tiers = TierSet(0, base, (), base_pruner=base_pruner)
         self._next_seq = 1
         self._compactions = 0
         self._compact_seconds = 0.0
@@ -318,10 +457,16 @@ class MutableIndex:
                 os.close(fd)
             self._wal = Wal.create(directory, sync=wal_sync,
                                    columns=self._columns)
+            prune_name = None
+            if base_pruner is not None:
+                prune_name = f"prune-{self._ckpt:08d}.flt"
+                write_pruner(
+                    os.path.join(directory, prune_name), base_pruner
+                )
             mf.write_manifest(directory, mf.manifest_doc(
                 mode=self.mode, key_columns=self._columns,
                 checkpoint=self._ckpt, base=self._base_file, applied_lsn=0,
-                segments=self._wal.segment_names(),
+                segments=self._wal.segment_names(), prune=prune_name,
             ))
         else:
             # recovery: replay the WAL tail newer than the manifest's
@@ -342,10 +487,13 @@ class MutableIndex:
                     delta = DeltaTier(lsn, None, (tuple(doc["key"]),))
                 else:
                     rows = [Row(r) for r in doc["rows"]]
-                    delta = DeltaTier(lsn, self._build_delta_index(rows))
+                    idx = self._build_delta_index(rows)
+                    delta = DeltaTier(lsn, idx,
+                                      pruner=self._make_pruner(idx))
                 ts = self._tiers
                 self._tiers = TierSet(ts.epoch + 1, ts.base,
-                                      ts.deltas + (delta,))
+                                      ts.deltas + (delta,),
+                                      base_pruner=ts.base_pruner)
                 self._next_seq = lsn + 1
             self.recovered_records = len(replay)
             self.recovery_info = info
@@ -405,6 +553,12 @@ class MutableIndex:
     def durable(self) -> bool:
         return self._wal is not None
 
+    @property
+    def readamp(self) -> ReadAmpTracker:
+        """Observed read-amplification counters (the read-amp-aware
+        Compactor polls ``readamp.take_window()``)."""
+        return self._readamp
+
     def tiers(self) -> TierSet:
         """Pin the current tier-set epoch (one atomic read)."""
         return self._tiers
@@ -431,6 +585,10 @@ class MutableIndex:
             "compactions": compactions,
             "compact_seconds_total": round(compact_s, 6),
         }
+        out["prune"] = dict(self._readamp.snapshot())
+        out["prune"]["enabled"] = bool(
+            self._prune and ts.prune_dir is not None
+        )
         if self._wal is not None:
             out["wal"] = self._wal.stats()
             out["checkpoint"] = ckpt
@@ -441,25 +599,93 @@ class MutableIndex:
     # -- reads (no lock on this path) --------------------------------------
 
     def bounds_many(self, probes: Sequence[Sequence[str]]) -> MultiBounds:
-        """Per-tier bounds for the whole probe batch: one vectorized
-        ``bounds_many`` pass per ROW tier (the existing multi-tier
-        ``point_bounds_many`` machinery), pinned to one epoch.
-        Tombstone tiers hold no rows — they join at merge time via
-        the pinned TierSet."""
+        """Per-tier bounds for the whole probe batch, pinned to one
+        epoch.  Tombstone tiers hold no rows — they join at merge time
+        via the pinned TierSet.
+
+        Read-path pruning (the r11→r12 cliff fix): before ANY per-tier
+        bounds pass, the pinned TierSet's :class:`PruneDirectory`
+        answers every (probe, tier) fence+filter test in one host numpy
+        pass and the bounds passes run only against the shortlist —
+        batched probes prune per-key against the shortlist union, so a
+        tier pays a bounds pass only for the probes it may actually
+        contain.  Pruning is one-sided (a skipped (probe, tier) pair is
+        PROVEN empty and reads back as the same ``(0, 0)`` the bounds
+        pass would have returned), so results are bitwise-identical
+        with pruning on or off; false positives cost one redundant
+        bounds pass.  Host numpy only — nothing here can recompile."""
         norm = [(p,) if isinstance(p, str) else tuple(p) for p in probes]
         width = len(self._columns)
         for p in norm:
             if len(p) > width:
                 raise ValueError("too many columns in Index.find()")
         ts = self._tiers
-        row_tiers = [ts.base] + [
-            d.index for d in ts.deltas if d.index is not None
-        ]
-        positions = [0] + [
-            p + 1 for p, d in enumerate(ts.deltas) if d.index is not None
-        ]
-        per_tier = [ix._impl.bounds_many(norm) for ix in row_tiers]
-        return MultiBounds(ts, per_tier, norm, row_tiers, positions)
+        row_tiers = ts.row_tiers
+        positions = ts.positions
+        n_tiers = len(row_tiers)
+        pd = ts.prune_dir
+        pruned = 0
+        if pd is not None and norm and n_tiers > 1:
+            t0 = time.perf_counter()
+            n_b = len(norm)
+            # tiers no probe survived drop out of the MultiBounds
+            # entirely: they would contribute only (0, 0) bounds, and
+            # carrying them would make rows_for_bounds pay one Python
+            # visit per pruned tier per probe — the cold-tier tax this
+            # pass exists to kill.  positions keep the ORIGINAL tier
+            # epochs, so tombstone age masks and upsert newest-wins
+            # ordering are unaffected by the renumbering.
+            kept_rt = []
+            kept_pos = []
+            per_tier = []
+            probed = 0
+            if n_b == 1:
+                # the serving single-probe shape: every surviving tier
+                # needs the full (1-probe) bounds pass — no pass
+                # matrix, no per-tier count bookkeeping
+                for t in pd.shortlist(norm[0]):
+                    per_tier.append(row_tiers[t]._impl.bounds_many(norm))
+                    kept_rt.append(row_tiers[t])
+                    kept_pos.append(positions[t])
+                probed = len(kept_rt)
+            else:
+                keep = pd.pass_matrix(norm)
+                counts = keep.sum(axis=0, dtype=np.int64).tolist()
+                empty = [(0, 0)] * n_b
+                for t, c in enumerate(counts):
+                    if not c:
+                        continue
+                    ix = row_tiers[t]
+                    if c == n_b:
+                        sub = ix._impl.bounds_many(norm)
+                    else:
+                        sel = np.flatnonzero(keep[:, t])
+                        part = ix._impl.bounds_many(
+                            [norm[int(i)] for i in sel]
+                        )
+                        sub = list(empty)
+                        for k, i in enumerate(sel):
+                            sub[int(i)] = part[k]
+                    per_tier.append(sub)
+                    kept_rt.append(ix)
+                    kept_pos.append(positions[t])
+                    probed += c
+            row_tiers = kept_rt
+            positions = kept_pos
+            pruned = n_b * n_tiers - probed
+            if telemetry.enabled:
+                telemetry.add_stage(
+                    "storage:prune", n_b * n_tiers, probed,
+                    time.perf_counter() - t0, tiers=n_tiers,
+                )
+        else:
+            per_tier = [ix._impl.bounds_many(norm) for ix in row_tiers]
+            probed = n_tiers * len(norm)
+        self._readamp.on_lookup_batch(len(norm), probed, pruned)
+        mb = MultiBounds(ts, per_tier, norm, row_tiers, positions)
+        mb.tiers_probed = probed
+        mb.tiers_pruned = pruned
+        return mb
 
     def rows_for_bounds(self, mb: MultiBounds) -> List[List[Row]]:
         """Merge per-tier bounds into per-probe row blocks with ONE
@@ -481,11 +707,7 @@ class MutableIndex:
         n_probes = len(mb.probes)
         width = len(self._columns)
         upsert = self.mode == "upsert"
-        tombs = [
-            (p + 1, d.tomb_set)
-            for p, d in enumerate(ts.deltas)
-            if d.tombs
-        ]
+        tombs = ts.tombs_by_age
         eff: List[List[Tuple[int, int]]] = [
             [(0, 0)] * n_probes for _ in range(n_tiers)
         ]
@@ -501,10 +723,7 @@ class MutableIndex:
             if tombs and full:
                 # whole-tier age mask: the newest tombstone holding this
                 # exact key erases every strictly older tier's rows
-                shadow = -1
-                for tp, tset in tombs:
-                    if tp > shadow and probe in tset:
-                        shadow = tp
+                shadow = ts.tomb_newest.get(probe, -1)
                 if shadow >= 0:
                     live = [t for t in live if positions[t] >= shadow]
                     if not live:
@@ -578,6 +797,13 @@ class MutableIndex:
         table = DeviceTable.from_rows(rows, device=self._device)
         return create_index(source_from_table(table), self._columns)
 
+    def _make_pruner(self, idx: Index) -> Optional[TierPruner]:
+        """Fences + filter for a freshly sealed tier (None when pruning
+        is disabled).  Runs at seal time, outside any reader path."""
+        if not self._prune:
+            return None
+        return build_pruner(idx._impl, self._columns)
+
     def append_rows(self, rows: Sequence) -> int:
         """Append a batch of rows as one new delta tier.
 
@@ -645,6 +871,7 @@ class MutableIndex:
             self._tiers = TierSet(
                 ts.epoch + 1, ts.base,
                 ts.deltas + (DeltaTier(seq, None, (norm,)),),
+                base_pruner=ts.base_pruner,
             )
 
     def wal_sync(self) -> Dict[str, int]:
@@ -670,6 +897,9 @@ class MutableIndex:
             # (replaying a stable sort of already-sorted rows rebuilds
             # the identical tier)
             wal_rows = [dict(r) for r in tier_rows(idx._impl)]
+        # seal-time summary build: the O(n) fence+filter scan runs
+        # outside the lock (the tier is private until the swap)
+        pruner = self._make_pruner(idx)
         with self._lock:
             seq = self._next_seq
             self._next_seq += 1
@@ -678,8 +908,9 @@ class MutableIndex:
                     seq, {"lsn": seq, "op": "rows", "rows": wal_rows}
                 )
             ts = self._tiers
-            delta = DeltaTier(seq, idx)
-            self._tiers = TierSet(ts.epoch + 1, ts.base, ts.deltas + (delta,))
+            delta = DeltaTier(seq, idx, pruner=pruner)
+            self._tiers = TierSet(ts.epoch + 1, ts.base, ts.deltas + (delta,),
+                                  base_pruner=ts.base_pruner)
 
     # -- compaction --------------------------------------------------------
 
@@ -749,17 +980,19 @@ class MutableIndex:
             # merge but BEFORE the swap must also leave the old tier
             # set intact (chaos scenario `storage_compact_crash`)
             faults.inject("storage:compact")
+            pruner = self._make_pruner(merged)  # outside the lock
             seconds = time.perf_counter() - t0
             with self._lock:
                 cur = self._tiers
                 self._tiers = TierSet(
-                    cur.epoch + 1, merged, cur.deltas[len(ts.deltas):]
+                    cur.epoch + 1, merged, cur.deltas[len(ts.deltas):],
+                    base_pruner=pruner,
                 )
                 self._compactions += 1
                 self._compact_seconds += seconds
             _t["rows_out"] = len(merged._impl)
         if self._wal is not None:
-            self._checkpoint(merged, ts.deltas[-1].seq)
+            self._checkpoint(merged, ts.deltas[-1].seq, pruner)
         return {
             "kind": "full",
             "deltas": len(ts.deltas),
@@ -792,19 +1025,22 @@ class MutableIndex:
             faults.inject("storage:compact")
             seconds = time.perf_counter() - t0
             n_out = len(merged._impl)
+            pruner = self._make_pruner(merged) if n_out else None
             with self._lock:
                 cur = self._tiers
                 # appends only extend the tail and merges serialize on
                 # _compact_lock, so cur.deltas[i:j] is still `run`
                 if n_out or tombs:
                     new = (
-                        DeltaTier(run[-1].seq, merged if n_out else None, tombs),
+                        DeltaTier(run[-1].seq, merged if n_out else None,
+                                  tombs, pruner=pruner),
                     )
                 else:
                     new = ()
                 self._tiers = TierSet(
                     cur.epoch + 1, cur.base,
                     cur.deltas[:i] + new + cur.deltas[j:],
+                    base_pruner=cur.base_pruner,
                 )
                 self._compactions += 1
                 self._compact_seconds += seconds
@@ -818,13 +1054,18 @@ class MutableIndex:
             "epoch": self._tiers.epoch,
         }
 
-    def _checkpoint(self, merged: Index, applied_lsn: int) -> None:
+    def _checkpoint(self, merged: Index, applied_lsn: int,
+                    pruner: Optional[TierPruner] = None) -> None:
         """Publish a full merge durably: persist the merged base
-        (versioned ``write_to`` format), seal the active WAL segment,
-        swap the manifest atomically, then drop applied segments and
-        stale files.  ``storage:manifest-swap`` fires in the
-        pre-rename (hit 0) and post-rename/pre-drop (hit 1) windows —
-        a crash in either recovers to the same logical stream."""
+        (versioned ``write_to`` format) and its prune sidecar, seal
+        the active WAL segment, swap the manifest atomically, then
+        drop applied segments and stale files.  ``storage:manifest-swap``
+        fires in the pre-rename (hit 0) and post-rename/pre-drop
+        (hit 1) windows; ``storage:prune-sidecar`` fires before (hit 0)
+        and after (hit 1) the sidecar write — a crash in ANY of these
+        leaves the previous manifest live (orphaned base/sidecar files
+        are swept on the next checkpoint) and recovers to the same
+        logical stream."""
         from . import manifest as mf
 
         directory = self._dir
@@ -840,12 +1081,18 @@ class MutableIndex:
         finally:
             os.close(fd)
         os.replace(tmp, final)
+        prune_name = None
+        if pruner is not None:
+            prune_name = f"prune-{ck:08d}.flt"
+            faults.inject("storage:prune-sidecar")
+            write_pruner(os.path.join(directory, prune_name), pruner)
+            faults.inject("storage:prune-sidecar")
         self._wal.seal_active()
         faults.inject("storage:manifest-swap")
         doc = mf.manifest_doc(
             mode=self.mode, key_columns=self._columns, checkpoint=ck,
             base=base_name, applied_lsn=int(applied_lsn),
-            segments=self._wal.segment_names(),
+            segments=self._wal.segment_names(), prune=prune_name,
         )
         mf.write_manifest(directory, doc)
         faults.inject("storage:manifest-swap")

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from ..errors import CsvPlusError
 
@@ -39,9 +39,13 @@ def manifest_doc(
     base: str,
     applied_lsn: int,
     segments: Sequence[str],
+    prune: Optional[str] = None,
 ) -> Dict[str, object]:
-    """Assemble the versioned manifest document."""
-    return {
+    """Assemble the versioned manifest document.  ``prune`` names the
+    base tier's fence/filter sidecar (``prune-%08d.flt``), or None when
+    the checkpoint was taken with pruning disabled — recovery then
+    rebuilds summaries by scan."""
+    doc: Dict[str, object] = {
         "magic": _MAGIC,
         "version": _VERSION,
         "mode": mode,
@@ -51,6 +55,9 @@ def manifest_doc(
         "applied_lsn": int(applied_lsn),
         "segments": list(segments),
     }
+    if prune is not None:
+        doc["prune"] = prune
+    return doc
 
 
 def write_manifest(directory: str, doc: Dict[str, object]) -> str:
@@ -103,11 +110,15 @@ def stale_files(directory: str, doc: Dict[str, object]) -> List[str]:
     tier files the manifest no longer references.  WAL segments are NOT
     listed — the WAL's own ``drop_applied`` owns their lifecycle."""
     keep = {MANIFEST_NAME, str(doc["base"])}
+    if doc.get("prune"):
+        keep.add(str(doc["prune"]))
     out: List[str] = []
     for name in os.listdir(directory):
         if name.endswith(".tmp"):
             out.append(name)
         elif name.startswith("base-") and name not in keep:
+            out.append(name)
+        elif name.startswith("prune-") and name not in keep:
             out.append(name)
     return sorted(out)
 

@@ -56,6 +56,17 @@ CRASH_WINDOWS = {
     # killed post-rename/pre-WAL-truncate: new base, stale swept
     "manifest_post_rename": (
         {"site": "storage:manifest-swap", "at": [1], "error": "fatal"}, 4, 0),
+    # killed before the checkpoint's prune sidecar write (ISSUE 11):
+    # old manifest + old sidecar still live, orphaned new base swept on
+    # recovery — fences/filters reload from the OLD sidecar and full
+    # WAL replay reconstructs every acked op
+    "sidecar_pre_write": (
+        {"site": "storage:prune-sidecar", "at": [0], "error": "fatal"}, 4, 4),
+    # killed after the sidecar write but before the manifest swap: the
+    # new base AND new sidecar are both orphans, both swept; recovery
+    # must not confuse the unreferenced sidecar with the live one
+    "sidecar_post_write": (
+        {"site": "storage:prune-sidecar", "at": [1], "error": "fatal"}, 4, 4),
     # clean run, then a torn partial frame on the active segment (a
     # kill mid write(2)): recovery truncates it, losing nothing acked
     "torn_tail": (None, 7, 3),
