@@ -1,15 +1,15 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal trace-smoke chaos check dryrun example coldcheck lint analyze asan
+.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal bench-view trace-smoke chaos check dryrun example coldcheck lint analyze asan
 
 test:
 	python -m pytest tests/ -x -q
 
 # The standing local gate: unit suite, static analysis, chaos
-# differential, mutable-index storage bench — the set a change must
-# keep green before review.
-check: test lint chaos bench-delta bench-wal
+# differential, mutable-index storage bench, materialized-view bench —
+# the set a change must keep green before review.
+check: test lint chaos bench-delta bench-wal bench-view
 
 # Static analysis gate (docs/ANALYSIS.md).  The repo AST lint (ctypes
 # boundary + jit retrace rules) always runs; ruff and mypy run when
@@ -120,6 +120,19 @@ bench-delta:
 bench-wal:
 	JAX_PLATFORMS=cpu python bench_wal.py
 
+# Live materialized-view bench (docs/VIEWS.md): incremental
+# maintenance of the 3-way join view over a 1M-row mutable source —
+# refresh ms per <=1K-row batch vs a from-scratch recompute (the gated
+# >=20x speedup), and view-read latency from the epoch-pinned
+# snapshot — with the ISSUE 12 hard contract enforced in-bench
+# (positional checksum parity vs a from-scratch execution after EVERY
+# batch, zero warm recompiles per refresh).  One compact JSON line
+# last; exits nonzero on a >2x regression vs bench_view_floor.json.
+# The checked-in record (BENCH_VIEW_r13.json) is only (re)written when
+# CSVPLUS_BENCH_VIEW_OUT is set.
+bench-view:
+	JAX_PLATFORMS=cpu python bench_view.py
+
 # Tracing-subsystem smoke (docs/OBSERVABILITY.md): a traced serving
 # pass on the micro lookup shape must produce per-request span trees,
 # the Chrome-trace export must pass the schema validator, and the
@@ -136,7 +149,9 @@ trace-smoke:
 # typed (dispatcher crashes fail every pending future with
 # ServerCrashed in <1s); every case runs under a watchdog so a hang is
 # a failure; the DISARMED injection hooks must cost <=1% of a served
-# request.  Writes CHAOS_r11.json; the unit-level chaos suite
+# request.  Also covers the views:refresh crash window (a dead view
+# refresh leaves the prior epoch-pinned snapshot served and retries).
+# Writes CHAOS_r12.json; the unit-level chaos suite
 # (tests/test_chaos.py) runs first.
 chaos:
 	JAX_PLATFORMS=cpu timeout -k 10 600 python -m pytest tests/test_chaos.py -q

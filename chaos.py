@@ -20,8 +20,15 @@ differential contract:
   (measured here, recorded in the artifact — the same discipline as
   `make trace-smoke`'s disabled-hook gate).
 
+The ISSUE 12 window extends the matrix to the materialized-view tier:
+a crash at ``views:refresh`` inside a serving write cycle must leave
+the PRIOR epoch-pinned snapshot live (same epoch, same checksums),
+every unapplied tier event queued, and the dispatcher alive — and the
+disarmed retry must converge the view back to bitwise parity with a
+from-scratch execution of its registered plan.
+
 Contract (matches the benches): diagnostics go to stderr, stdout
-carries ONE compact JSON line; CHAOS_r11.json records the full
+carries ONE compact JSON line; CHAOS_r12.json records the full
 evidence — per-case injection counts (``FaultPlan.snapshot``), recovery
 outcomes, serve retry/degrade metrics, telemetry counters
 (``ingest.worker_recovered``), and the overhead measurement.  Exits
@@ -53,7 +60,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 #: Watchdog bound per chaos case: a case that cannot finish inside this
 #: is a hang, which is exactly what the resilience layer must prevent.
 CASE_TIMEOUT_S = float(os.environ.get("CSVPLUS_CHAOS_CASE_TIMEOUT", 120))
-ARTIFACT = os.path.join(REPO, "CHAOS_r11.json")
+ARTIFACT = os.path.join(REPO, "CHAOS_r12.json")
 #: Disarmed-hook budget: injection sites on the serve path may cost at
 #: most this fraction of one served request.
 OVERHEAD_BUDGET_PCT = 1.0
@@ -559,6 +566,115 @@ def case_wal_crash_matrix(tmp_root):
     }
 
 
+# ---- materialized views: refresh crash window (ISSUE 12) -----------------
+
+
+def case_view_refresh_crash():
+    """A fatal fault at the top of the view-refresh pass inside a
+    serving write cycle: the prior epoch-pinned snapshot stays live,
+    the events stay queued, the dispatcher survives — and the disarmed
+    retry converges back to from-scratch parity."""
+    from csvplus_tpu import plan as P
+    from csvplus_tpu.index import create_index
+    from csvplus_tpu.resilience import faults
+    from csvplus_tpu.resilience.faults import FaultPlan
+    from csvplus_tpu.row import Row
+    from csvplus_tpu.serve import LookupServer
+    from csvplus_tpu.source import take_rows
+    from csvplus_tpu.storage import MutableIndex
+
+    n_cust, n_prod = 40, 12
+
+    def order(i):
+        return Row({
+            "oid": f"o{i:05d}",
+            "cust_id": f"c{i % n_cust:03d}",
+            "prod_id": f"p{i % n_prod:03d}",
+        })
+
+    mi = MutableIndex.create(
+        take_rows([order(i) for i in range(1500)]), ["oid"],
+        ingest_device="cpu",
+    )
+    cust = create_index(
+        take_rows([Row({"cust_id": f"c{i:03d}", "name": f"n{i:03d}"})
+                   for i in range(n_cust)]),
+        ["cust_id"],
+    )
+    cust.on_device("cpu")
+    prod = create_index(
+        take_rows([Row({"prod_id": f"p{i:03d}", "label": f"l{i:03d}"})
+                   for i in range(n_prod)]),
+        ["prod_id"],
+    )
+    prod.on_device("cpu")
+    root = P.Join(
+        P.Join(P.Scan(None), cust, ("cust_id",)), prod, ("prod_id",)
+    )
+    with LookupServer(indexes={"orders": mi}) as srv:
+        view = srv.register_view("enriched", root, source="orders")
+        base_cs = view.checksums()
+        snap0, epoch0 = view.snapshot(), view.epoch
+        with faults.active(
+            FaultPlan(
+                [{"site": "views:refresh", "at": [0], "error": "fatal"}],
+                seed=17,
+            )
+        ) as plan:
+            # the write cycle lands its tier + tombstone, then its
+            # refresh pass crashes (caught by the dispatcher's sweep)
+            fa = srv.submit_append([order(2000)], index="orders")
+            fd = srv.submit_delete(("o00007",), index="orders")
+            acked = fa.result(timeout=30.0) == 1 and fd.result(timeout=30.0) == 1
+            deadline = time.perf_counter() + 30.0
+            failures = 0
+            while time.perf_counter() < deadline:
+                cell = srv.snapshot()["by_view"].get("enriched", {})
+                failures = int(cell.get("failures", 0))
+                if failures:
+                    break
+                time.sleep(0.01)
+            # the prior snapshot is still the live one: same object,
+            # same epoch, same contents; the events are still queued
+            intact = (
+                view.snapshot() is snap0
+                and view.epoch == epoch0
+                and view.checksums() == base_cs
+                and view.pending >= 1
+            )
+            injections = plan.snapshot()
+        # dispatcher alive: this lookup's cycle also retries the (now
+        # disarmed) refresh and drains the queue
+        alive = srv.lookup("o00005", index="orders") != []
+        deadline = time.perf_counter() + 30.0
+        while view.pending and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        converged = view.pending == 0
+        parity = view.checksums() == view.recompute_checksums()
+        resurrect_gone = view.read("o00007") == []
+        cell = srv.snapshot()["by_view"]["enriched"]
+    return {
+        "ok": acked
+        and failures >= 1
+        and intact
+        and alive
+        and converged
+        and parity
+        and resurrect_gone
+        and injections["fired"].get("views:refresh", 0) == 1,
+        "write_futures_acked": acked,
+        "refresh_failures_recorded": failures,
+        "prior_snapshot_intact": intact,
+        "dispatcher_alive": alive,
+        "retry_converged": converged,
+        "from_scratch_parity": parity,
+        "injections": injections,
+        "view_cell": {
+            k: cell[k] for k in ("refreshes", "events", "failures", "epoch")
+        },
+    }
+
+
 # ---- disarmed-hook overhead gate -----------------------------------------
 
 
@@ -671,6 +787,9 @@ def main() -> int:
             cases["wal_crash_matrix"] = _with_timeout(
                 "wal_crash_matrix",
                 lambda: case_wal_crash_matrix(tmp_root),
+            )
+            cases["view_refresh_crash"] = _with_timeout(
+                "view_refresh_crash", case_view_refresh_crash
             )
             cases["disarmed_overhead"] = _with_timeout(
                 "disarmed_overhead", lambda: case_disarmed_overhead(idx, ids)

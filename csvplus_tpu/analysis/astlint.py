@@ -408,6 +408,25 @@ _WORKER_ENTRY_NAMES = (
     "bounds_many",
     "on_lookup_batch",
     "take_window",
+    # csvplus_tpu/views + serve view entry points (ISSUE 12): the
+    # tier-swap listener registry mutators and the event-intake
+    # callback (fired UNDER the source's writer lock from every writer
+    # thread), the refresh pass (serve dispatcher + caller threads) and
+    # the lock-free snapshot read path, the server's view registration
+    # and delete submission, the per-view metrics mutators, and the
+    # lazy pruner/prune-directory builds the probe path races against
+    # tier swaps (made lazy in this issue).
+    "subscribe",
+    "unsubscribe",
+    "_on_tier_event",
+    "refresh",
+    "read",
+    "register_view",
+    "submit_delete",
+    "on_view_refresh",
+    "on_view_read",
+    "ensure_pruner",
+    "prune_directory",
 )
 
 _EAGER_TRANSFORM_OPS = frozenset(

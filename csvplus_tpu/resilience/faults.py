@@ -43,6 +43,13 @@ through the tree at existing span/stage boundaries:
   crash leaves the OLD manifest (and old sidecar) live; recovery
   reloads or rebuilds summaries and sweeps the orphans — pruning state
   can never diverge from the base it describes.
+* ``views:refresh`` — top of every materialized-view refresh pass
+  (``views/view.py``, ISSUE 12).  A raise here (or anywhere in the
+  incremental apply) must leave the PRIOR epoch-pinned snapshot live
+  and every unapplied tier event queued, so readers keep answering
+  from the last consistent epoch and the next refresh (the serving
+  cycle retries automatically) converges to the same contents a
+  from-scratch execution would produce.
 
 DISCIPLINE: the disarmed path is one module-global ``None`` check per
 site (:func:`inject`), the same budget rule as the tracing subsystem's
@@ -109,6 +116,7 @@ SITES = (
     "storage:wal-write",
     "storage:manifest-swap",
     "storage:prune-sidecar",
+    "views:refresh",
 )
 
 

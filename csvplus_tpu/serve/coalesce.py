@@ -4,7 +4,7 @@
 :class:`~csvplus_tpu.index.Index` or
 :class:`~csvplus_tpu.storage.MutableIndex`).  Callers submit single
 point-lookup probes (or whole plan-IR queries, or — against a mutable
-index — append batches) from any thread; a single dispatcher thread
+index — append batches and key deletes) from any thread; a single dispatcher thread
 drains the pending queue into one ``find_rows_many`` call per (cycle,
 index) pair and scatters the per-key row blocks back to caller futures.  The batched engine's economics carry
 over wholesale: 32 independent single-key clients ride the same
@@ -105,16 +105,17 @@ class ServeFuture:
     whatever the batched call raised).
     """
 
-    __slots__ = ("probe", "plan", "rows", "index_name", "deadline_s",
-                 "callback", "t_submit", "t_dispatch", "trace_ctx", "value",
-                 "error", "_event", "_done")
+    __slots__ = ("probe", "plan", "rows", "del_key", "index_name",
+                 "deadline_s", "callback", "t_submit", "t_dispatch",
+                 "trace_ctx", "value", "error", "_event", "_done")
 
     def __init__(self, probe, plan, deadline_s, callback,
-                 index_name=DEFAULT_INDEX, rows=None):
+                 index_name=DEFAULT_INDEX, rows=None, del_key=None):
         self._done = False
         self.probe = probe
         self.plan = plan
         self.rows = rows
+        self.del_key = del_key
         self.index_name = index_name
         self.deadline_s = deadline_s
         self.callback = callback
@@ -174,6 +175,9 @@ class LookupServer:
         if not regs:
             raise ValueError("LookupServer needs at least one index")
         self._indexes = regs
+        # registered live views (name -> MaterializedView), swapped
+        # whole under self._cv like the index registry
+        self._views: dict = {}
         default = regs.get(DEFAULT_INDEX) or regs[next(iter(regs))]
         self._default_name = default.name
         # back-compat aliases for the single-index surface (tests, the
@@ -218,6 +222,53 @@ class LookupServer:
             regs = dict(self._indexes)
             regs[reg.name] = reg
             self._indexes = regs
+
+    def register_view(self, name: str, root, *, source: Optional[str] = None):
+        """Register a live materialized view of plan *root* over the
+        MUTABLE index registered as *source* (default route when
+        omitted) and return it.
+
+        Registration gates the plan — the delta-rule check
+        (:class:`~csvplus_tpu.views.ViewRejected`) and static
+        verification through this server's plan cache
+        (:class:`~csvplus_tpu.serve.plancache.PlanRejected`) both raise
+        typed HERE, never later — then builds the initial snapshot and
+        subscribes to the source's tier events.  From then on every
+        dispatch cycle refreshes the view AFTER the cycle's writes land
+        (and before its lookups), so a reader that saw an append future
+        complete sees the view contents include it by the next cycle.
+        ``view(name).read(key)`` answers sub-ms from the epoch-pinned
+        snapshot on the caller's thread — reads never queue."""
+        from ..views import MaterializedView
+
+        reg = self._registered(source)
+        if not reg.mutable or not hasattr(reg.impl, "subscribe"):
+            raise TypeError(
+                f"index {reg.name!r} is not a MutableIndex — views need "
+                f"a tier-event source"
+            )
+        view = MaterializedView(
+            str(name), root, reg.impl,
+            plancache=self.plancache, metrics=self.metrics,
+        )
+        with self._cv:
+            views = dict(self._views)
+            views[str(name)] = view
+            self._views = views
+        return view
+
+    def view(self, name: str):
+        """The registered :class:`~csvplus_tpu.views.MaterializedView`."""
+        v = self._views.get(str(name))
+        if v is None:
+            raise KeyError(
+                f"no view registered as {name!r} "
+                f"(have: {', '.join(sorted(self._views))})"
+            )
+        return v
+
+    def view_names(self) -> List[str]:
+        return sorted(self._views)
 
     def _registered(self, name: Optional[str]) -> "_Registered":
         regs = self._indexes
@@ -333,6 +384,48 @@ class LookupServer:
         its appended row count."""
         return self.submit_append(rows, deadline_s=deadline_s, index=index).result()
 
+    def submit_delete(
+        self,
+        key: Sequence[str],
+        *,
+        deadline_s: Optional[float] = None,
+        callback: Optional[Callable[[ServeFuture], None]] = None,
+        index: Optional[str] = None,
+    ) -> ServeFuture:
+        """Enqueue one full-width-key tombstone against a MUTABLE named
+        index.  Writes drained into one dispatch cycle — appends AND
+        deletes — apply in SUBMISSION order before the cycle's view
+        refresh and lookups, so a delete()+append() for the same key
+        lands exactly as the caller issued it.  The future's value is
+        the tombstoned key count (1)."""
+        reg = self._registered(index)
+        if not reg.mutable or not hasattr(reg.impl, "delete"):
+            raise TypeError(
+                f"index {reg.name!r} is immutable (register a "
+                f"MutableIndex to accept deletes)"
+            )
+        norm = (key,) if isinstance(key, str) else tuple(key)
+        if len(norm) != reg.key_width:
+            raise ValueError(
+                f"delete() needs a full-width key ({reg.key_width} "
+                f"columns, got {len(norm)})"
+            )
+        return self._enqueue(
+            ServeFuture(None, None, deadline_s, callback,
+                        index_name=reg.name, del_key=norm)
+        )
+
+    def delete(
+        self,
+        key: Sequence[str],
+        *,
+        deadline_s: Optional[float] = None,
+        index: Optional[str] = None,
+    ) -> int:
+        """Blocking convenience: submit one tombstone and wait for it
+        to be applied (and, on a durable index, synced)."""
+        return self.submit_delete(key, deadline_s=deadline_s, index=index).result()
+
     def submit_plan(
         self,
         root,
@@ -415,7 +508,7 @@ class LookupServer:
         regs = self._indexes  # one snapshot for the whole cycle
         samples: List[tuple] = []
         lookups: dict = {}  # index name -> sub-batch
-        appends: dict = {}
+        writes: dict = {}  # index name -> appends+deletes, submission order
         plans: List[ServeFuture] = []
         for req in batch:
             req.t_dispatch = t0
@@ -424,14 +517,16 @@ class LookupServer:
                 self._complete(req, None, expired, samples)
             elif req.plan is not None:
                 plans.append(req)
-            elif req.rows is not None:
-                appends.setdefault(req.index_name, []).append(req)
+            elif req.rows is not None or req.del_key is not None:
+                writes.setdefault(req.index_name, []).append(req)
             else:
                 lookups.setdefault(req.index_name, []).append(req)
-        # appends land BEFORE the cycle's lookups: a lookup coalesced
-        # into the same dispatch cycle as an append observes it
-        for name, reqs in appends.items():
-            self._run_appends(regs[name], reqs, samples)
+        # writes land BEFORE the cycle's view refresh and lookups: a
+        # lookup (or view read) coalesced into the same dispatch cycle
+        # as a write observes it
+        for name, reqs in writes.items():
+            self._run_writes(regs[name], reqs, samples)
+        self._refresh_views()
         for name, reqs in lookups.items():
             self._run_lookups(regs[name], reqs, samples)
         for req in plans:
@@ -462,28 +557,47 @@ class LookupServer:
         self.metrics.on_complete_batch(samples)
         self.metrics.observe_dispatch(len(batch), time.perf_counter() - t0)
 
-    def _run_appends(
+    def _run_writes(
         self, reg: _Registered, reqs: List[ServeFuture], samples: List[tuple]
     ) -> None:
-        """One coalesced append against one mutable index: every
-        request's rows concatenate into a SINGLE ``append_rows`` call —
-        one columnarize + encode + sort, one delta tier — then each
-        future completes with its own row count.
+        """One mutable index's writes for the cycle, applied in
+        SUBMISSION order: contiguous append runs concatenate into a
+        single ``append_rows`` call each (one columnarize + encode +
+        sort, one delta tier per run), with each ``delete`` applied
+        between runs exactly where the caller issued it — the ISSUE 12
+        ordering fix, so delete()+append() for one key in one cycle
+        resolves the way it was submitted.  A cycle of appends only is
+        byte-identical to the old single-call path.
 
         Durable-ack ordering: against a durable index the cycle's WAL
         records are forced to disk (``wal_sync()`` — the ``batch``
         policy's fsync barrier; a cheap no-op under ``always``/``off``)
-        BEFORE any future in the cycle completes, so a completed append
+        BEFORE any future in the cycle completes, so a completed write
         future is a durability promise, not just a visibility one.  A
-        sync failure fails every future in the cycle — nothing was
-        acked, and recovery will not replay the unsynced tail."""
-        rows_all: List[Row] = []
-        for req in reqs:
-            rows_all.extend(req.rows)
+        failure anywhere fails EVERY future in the cycle un-acked
+        (writes sequenced before the failure may have applied, but no
+        caller was promised anything; an unsynced tail is not
+        replayed)."""
         t_a = time.perf_counter()
         wal_stats = None
+        rows_appended = 0
+        append_reqs = delete_reqs = 0
         try:
-            reg.impl.append_rows(rows_all)
+            run: List[Row] = []
+            for req in reqs:
+                if req.rows is not None:
+                    append_reqs += 1
+                    run.extend(req.rows)
+                    continue
+                if run:
+                    reg.impl.append_rows(run)
+                    rows_appended += len(run)
+                    run = []
+                delete_reqs += 1
+                reg.impl.delete(req.del_key)
+            if run:
+                reg.impl.append_rows(run)
+                rows_appended += len(run)
             sync = getattr(reg.impl, "wal_sync", None)
             if sync is not None:
                 wal_stats = sync()
@@ -494,16 +608,39 @@ class LookupServer:
             phases = (("serve:append", t_a, time.perf_counter()),)
             for req in reqs:
                 self._complete(
-                    req, len(req.rows), None, samples,
-                    batch_n=len(reqs), phases=phases,
+                    req, len(req.rows) if req.rows is not None else 1,
+                    None, samples, batch_n=len(reqs), phases=phases,
                 )
         self.metrics.on_index_batch(
             reg.name,
-            append_reqs=len(reqs),
-            rows_appended=len(rows_all),
+            append_reqs=append_reqs,
+            delete_reqs=delete_reqs,
+            rows_appended=rows_appended,
             deltas_live=getattr(reg.impl, "delta_count", None),
             wal=wal_stats,
         )
+
+    def _refresh_views(self) -> None:
+        """Refresh every registered view with pending tier events —
+        ordered AFTER the cycle's writes, BEFORE its lookups.  A
+        failing refresh (the ``views:refresh`` fault site) leaves that
+        view's prior snapshot live and its events queued: readers keep
+        the last consistent epoch, the failure is counted, and the next
+        cycle retries — a crashed refresh never takes the dispatcher
+        down with it."""
+        views = self._views
+        for name, view in views.items():
+            if not view.pending:
+                continue
+            try:
+                view.refresh()
+            except Exception as err:
+                self.metrics.on_view_refresh(name, failures=1)
+                sys.stderr.write(
+                    f"csvplus-serve: view {name!r} refresh failed "
+                    f"({type(err).__name__}: {err}); prior snapshot "
+                    f"stays live, retrying next cycle\n"
+                )
 
     def _run_lookups(
         self, reg: _Registered, lookups: List[ServeFuture], samples: List[tuple]

@@ -88,6 +88,7 @@ def _new_index_cell() -> Dict[str, object]:
     return {
         "lookups": 0,
         "append_reqs": 0,
+        "delete_reqs": 0,
         "rows_appended": 0,
         # read-amplification observed by the serving tier: per-tier
         # bounds passes paid / skipped via fence+filter pruning
@@ -106,6 +107,21 @@ def _new_index_cell() -> Dict[str, object]:
         "wal_bytes": 0,
         "wal_fsyncs": 0,
         "recovered_records": 0,
+    }
+
+
+def _new_view_cell() -> Dict[str, object]:
+    """A fresh per-view counter cell (ISSUE 12: one cell per registered
+    materialized view, created under the monitor lock on first touch)."""
+    return {
+        "refreshes": 0,        # refresh passes that applied >= 1 event
+        "events": 0,           # tier events applied (appends + tombs)
+        "rows_probed": 0,      # view rows produced by incremental probes
+        "rows_retracted": 0,   # view rows masked by tombstone events
+        "failures": 0,         # refresh passes that raised (and retried)
+        "reads": 0,            # view.read() calls answered
+        "rows_read": 0,        # rows those reads returned
+        "epoch": 0,            # latest published snapshot epoch
     }
 
 
@@ -179,6 +195,8 @@ class ServingMetrics:
         # per-index split (multi-index routing + the storage write
         # path): name -> counter cell, created on first touch
         self._by_index: Dict[str, Dict[str, object]] = {}
+        # per-view split (live materialized views), same shape
+        self._by_view: Dict[str, Dict[str, object]] = {}
 
     # -- dispatcher-side ---------------------------------------------------
 
@@ -240,6 +258,7 @@ class ServingMetrics:
         *,
         lookups: int = 0,
         append_reqs: int = 0,
+        delete_reqs: int = 0,
         rows_appended: int = 0,
         tiers_probed: Optional[int] = None,
         tiers_pruned: Optional[int] = None,
@@ -258,6 +277,7 @@ class ServingMetrics:
             cell = self._by_index.setdefault(name, _new_index_cell())
             cell["lookups"] += lookups
             cell["append_reqs"] += append_reqs
+            cell["delete_reqs"] += delete_reqs
             cell["rows_appended"] += rows_appended
             if tiers_probed is not None:
                 cell["tiers_probed"] += int(tiers_probed)
@@ -295,6 +315,42 @@ class ServingMetrics:
             cell["compact_seconds_total"] += float(seconds)
             cell["last_compact_ms"] = round(float(seconds) * 1e3, 4)
             cell["deltas_live"] = int(deltas_live)
+
+    # -- per-view (live materialized views, ISSUE 12) ----------------------
+
+    def on_view_refresh(
+        self,
+        name: str,
+        *,
+        events: int = 0,
+        rows_probed: int = 0,
+        rows_retracted: int = 0,
+        failures: int = 0,
+        epoch: Optional[int] = None,
+    ) -> None:
+        """One view refresh pass — a single lock round per (cycle,
+        view) pair, same discipline as :meth:`on_index_batch`.  A
+        successful pass reports the events it applied and the rows it
+        probed/retracted; a failed pass reports ``failures=1`` (the
+        prior snapshot stayed live and the events remain queued)."""
+        with self._lock:
+            cell = self._by_view.setdefault(name, _new_view_cell())
+            if events:
+                cell["refreshes"] += 1
+            cell["events"] += int(events)
+            cell["rows_probed"] += int(rows_probed)
+            cell["rows_retracted"] += int(rows_retracted)
+            cell["failures"] += int(failures)
+            if epoch is not None:
+                cell["epoch"] = int(epoch)
+
+    def on_view_read(self, name: str, *, rows: int = 0) -> None:
+        """One ``view.read()`` answered from the epoch-pinned snapshot
+        (caller's thread — reads never queue through the dispatcher)."""
+        with self._lock:
+            cell = self._by_view.setdefault(name, _new_view_cell())
+            cell["reads"] += 1
+            cell["rows_read"] += int(rows)
 
     # -- submit-side -------------------------------------------------------
 
@@ -334,6 +390,13 @@ class ServingMetrics:
                         for k, v in cell.items()
                     }
                     for name, cell in sorted(self._by_index.items())
+                },
+                "by_view": {
+                    name: {
+                        k: (round(v, 6) if isinstance(v, float) else v)
+                        for k, v in cell.items()
+                    }
+                    for name, cell in sorted(self._by_view.items())
                 },
             }
         if plancache is not None:

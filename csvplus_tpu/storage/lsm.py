@@ -67,17 +67,33 @@ list's tail.  ``append_rows``, ``delete``, ``compact_once``,
 (analysis/astlint.py): every shared-state mutation below them must sit
 under a lock, with zero allowances.
 
-Read pruning (ISSUE 11)
------------------------
+Read pruning (ISSUE 11, lazy since ISSUE 12)
+--------------------------------------------
 
-Each sealed row tier carries a :class:`~csvplus_tpu.storage.prune.TierPruner`
+Each row tier carries a :class:`~csvplus_tpu.storage.prune.TierPruner`
 (min/max key fences + a seeded Bloom filter); every :meth:`bounds_many`
 batch consults the TierSet's :class:`~csvplus_tpu.storage.prune.PruneDirectory`
-on the host to shortlist tiers BEFORE any per-tier bounds pass.
-Pruning is one-sided, so results are bitwise-identical with it on or
-off (``CSVPLUS_LSM_PRUNE=0`` disables it).  Checkpoints persist the
-merged base's summaries as a ``prune-%08d.flt`` sidecar named in the
+on the host to shortlist tiers BEFORE any per-tier bounds pass.  Delta
+summaries build LAZILY on the first probe after a swap (cached on the
+DeltaTier, shared across epochs), so the append path no longer pays
+the O(n) fence+filter scan per sealed batch.  Pruning is one-sided, so
+results are bitwise-identical with it on or off
+(``CSVPLUS_LSM_PRUNE=0`` disables it).  Checkpoints persist the merged
+base's summaries as a ``prune-%08d.flt`` sidecar named in the
 manifest, so recovery reloads them without a rescan.
+
+Tier-swap listeners (ISSUE 12)
+------------------------------
+
+:meth:`MutableIndex.subscribe` registers a callback that fires on
+every append (``("rows", seq, index)``) and delete
+(``("tombs", seq, keys)``) tier swap — the live materialized views'
+delta feed (:mod:`csvplus_tpu.views`).  Callbacks run UNDER the writer
+lock immediately after the swap, so delivery order is exactly tier
+order with no gaps relative to the TierSet returned at subscription;
+the contract is that a listener is O(1) enqueue-only, never raises,
+and never calls back into the index.  Compactions fire no events:
+they rewrite physical tiers, not the logical stream.
 """
 
 from __future__ import annotations
@@ -126,7 +142,8 @@ class DeltaTier:
     rows — after a partial merge a tier carries both, and its rows were
     appended after its deletes)."""
 
-    __slots__ = ("seq", "index", "tombs", "tomb_set", "pruner")
+    __slots__ = ("seq", "index", "tombs", "tomb_set", "pruner",
+                 "_pruner_built", "_plock")
 
     def __init__(self, seq: int, index: Optional[Index],
                  tombs: Sequence[Tuple[str, ...]] = (),
@@ -142,7 +159,27 @@ class DeltaTier:
         # Tombstones themselves are NEVER pruned — shadowing reads the
         # tomb_set directly, so a pruned row tier cannot un-shadow
         # anything.
+        #
+        # Freshly appended tiers arrive WITHOUT a pruner (the write-side
+        # tax fix): the O(n) fence+filter scan is deferred to the first
+        # probe via ensure_pruner, and the built summary is cached HERE
+        # — the tier object survives TierSet swaps, so successor epochs
+        # reuse it and each sealed batch pays the scan at most once.
         self.pruner = pruner
+        self._pruner_built = pruner is not None or index is None
+        self._plock = threading.Lock()
+
+    def ensure_pruner(self, key_columns: Sequence[str]) -> Optional[TierPruner]:
+        """The tier's pruner, building it on first demand (double-
+        checked under the per-tier lock — the IndexImpl lazy-build
+        idiom, so concurrent first probes scan once)."""
+        if self._pruner_built:
+            return self.pruner
+        with self._plock:
+            if not self._pruner_built:
+                self.pruner = build_pruner(self.index._impl, key_columns)
+                self._pruner_built = True
+        return self.pruner
 
     @property
     def nrows(self) -> int:
@@ -164,7 +201,8 @@ class TierSet:
     """
 
     __slots__ = ("epoch", "base", "deltas", "base_pruner", "prune_dir",
-                 "row_tiers", "positions", "tombs_by_age", "tomb_newest")
+                 "row_tiers", "positions", "tombs_by_age", "tomb_newest",
+                 "key_columns", "_pd_built", "_pd_lock")
 
     def __init__(self, epoch: int, base: Index, deltas: Tuple[DeltaTier, ...],
                  base_pruner: Optional[TierPruner] = None):
@@ -172,6 +210,7 @@ class TierSet:
         self.base = base
         self.deltas = deltas
         self.base_pruner = base_pruner
+        self.key_columns = tuple(base._impl.columns)
         # read-path projections, computed ONCE per swap: rebuilding
         # these per lookup costs one Python pass over every delta —
         # measurable at 100+ tiers even when pruning skips them all
@@ -192,20 +231,39 @@ class TierSet:
             for key in tset:
                 newest[key] = p  # tombs_by_age ascends: last write wins
         self.tomb_newest = newest
-        # the read path's prune directory is built EAGERLY here, under
-        # the writer's lock (every TierSet is constructed by a writer),
-        # so probes touch only immutable state — the THREAD001 rule.
-        # Pruning engages only when the base AND every row tier carry a
-        # pruner; a single pruner-less row tier disables it (correct,
-        # just slower — never wrong).
-        pd = None
-        if base_pruner is not None:
-            prs = [base_pruner] + [
-                d.pruner for d in deltas if d.index is not None
-            ]
-            if all(p is not None for p in prs):
-                pd = PruneDirectory(prs, len(base._impl.columns))
-        self.prune_dir = pd
+        # the read path's prune directory is built LAZILY on the first
+        # probe (satellite of ISSUE 12): appends no longer pay the O(n)
+        # fence+filter scan per sealed delta — the first bounds_many
+        # after a swap does, once, with each per-tier summary cached on
+        # the DeltaTier itself so successor epochs reuse it.  Pruning
+        # engages only when a base pruner exists (CSVPLUS_LSM_PRUNE on
+        # at seal time); with it off prune_dir stays None forever.
+        self.prune_dir = None
+        self._pd_built = base_pruner is None
+        self._pd_lock = threading.Lock()
+
+    def prune_directory(self) -> Optional[PruneDirectory]:
+        """The epoch's prune directory, aggregated on first demand.
+
+        Double-checked under the per-TierSet lock (the IndexImpl
+        lazy-build idiom THREAD001 sanctions): concurrent first probes
+        build once; every later probe is the same single attribute read
+        the eager path had.  Missing delta summaries are built through
+        :meth:`DeltaTier.ensure_pruner`, which caches them on the tier
+        object — shared across epochs, so each sealed batch is scanned
+        at most once over its whole lifetime."""
+        if self._pd_built:
+            return self.prune_dir
+        with self._pd_lock:
+            if not self._pd_built:
+                prs = [self.base_pruner] + [
+                    d.ensure_pruner(self.key_columns)
+                    for d in self.deltas if d.index is not None
+                ]
+                if all(p is not None for p in prs):
+                    self.prune_dir = PruneDirectory(prs, len(self.key_columns))
+                self._pd_built = True
+        return self.prune_dir
 
     def indexes(self) -> Tuple[Index, ...]:
         """All ROW tiers oldest→newest (base first; pure tombstone
@@ -407,6 +465,9 @@ class MutableIndex:
         # rebuild scan — slower startup, never wrong answers.
         self._prune = prune_enabled()
         self._readamp = ReadAmpTracker()
+        # tier-swap listeners (the views delta feed) — a tuple swapped
+        # whole under self._lock so delivery iterates immutable state
+        self._listeners: Tuple = ()
         base_pruner: Optional[TierPruner] = None
         if self._prune:
             side = None if _manifest is None else _manifest.get("prune")
@@ -488,8 +549,9 @@ class MutableIndex:
                 else:
                     rows = [Row(r) for r in doc["rows"]]
                     idx = self._build_delta_index(rows)
-                    delta = DeltaTier(lsn, idx,
-                                      pruner=self._make_pruner(idx))
+                    # no seal-time pruner: the first probe builds it
+                    # (same lazy rule as the live append path)
+                    delta = DeltaTier(lsn, idx)
                 ts = self._tiers
                 self._tiers = TierSet(ts.epoch + 1, ts.base,
                                       ts.deltas + (delta,),
@@ -563,6 +625,30 @@ class MutableIndex:
         """Pin the current tier-set epoch (one atomic read)."""
         return self._tiers
 
+    def subscribe(self, callback) -> TierSet:
+        """Register a tier-swap listener and return the TierSet pinned
+        at registration — every later append/delete fires exactly one
+        event after it, so replaying the pinned set then applying
+        events in delivery order reconstructs the logical stream with
+        no gap and no duplicate (the views subsystem's feed).
+
+        Events are ``("rows", seq, index)`` for an append tier and
+        ``("tombs", seq, keys)`` for a tombstone tier (*keys* a tuple
+        of full-width key tuples).  The callback runs UNDER the writer
+        lock: it must be O(1) enqueue-only, must not raise, and must
+        not call back into this index."""
+        with self._lock:
+            self._listeners = self._listeners + (callback,)
+            return self._tiers
+
+    def unsubscribe(self, callback) -> None:
+        """Remove a tier-swap listener (no-op when absent); events
+        already delivered stay delivered."""
+        with self._lock:
+            self._listeners = tuple(
+                cb for cb in self._listeners if cb is not callback
+            )
+
     def __len__(self) -> int:
         ts = self._tiers
         return sum(len(ix._impl) for ix in ts.indexes())
@@ -586,8 +672,10 @@ class MutableIndex:
             "compact_seconds_total": round(compact_s, 6),
         }
         out["prune"] = dict(self._readamp.snapshot())
+        # base_pruner presence (not prune_dir, which builds lazily on
+        # the first probe) is what decides whether probes can prune
         out["prune"]["enabled"] = bool(
-            self._prune and ts.prune_dir is not None
+            self._prune and ts.base_pruner is not None
         )
         if self._wal is not None:
             out["wal"] = self._wal.stats()
@@ -623,7 +711,7 @@ class MutableIndex:
         row_tiers = ts.row_tiers
         positions = ts.positions
         n_tiers = len(row_tiers)
-        pd = ts.prune_dir
+        pd = ts.prune_directory()
         pruned = 0
         if pd is not None and norm and n_tiers > 1:
             t0 = time.perf_counter()
@@ -873,6 +961,8 @@ class MutableIndex:
                 ts.deltas + (DeltaTier(seq, None, (norm,)),),
                 base_pruner=ts.base_pruner,
             )
+            for cb in self._listeners:
+                cb(("tombs", seq, (norm,)))
 
     def wal_sync(self) -> Dict[str, int]:
         """Force buffered WAL records durable (the ``batch`` policy's
@@ -897,9 +987,9 @@ class MutableIndex:
             # (replaying a stable sort of already-sorted rows rebuilds
             # the identical tier)
             wal_rows = [dict(r) for r in tier_rows(idx._impl)]
-        # seal-time summary build: the O(n) fence+filter scan runs
-        # outside the lock (the tier is private until the swap)
-        pruner = self._make_pruner(idx)
+        # no seal-time summary build: the first probe after the swap
+        # pays the O(n) fence+filter scan once, via
+        # DeltaTier.ensure_pruner — the write path stays scan-free
         with self._lock:
             seq = self._next_seq
             self._next_seq += 1
@@ -908,9 +998,11 @@ class MutableIndex:
                     seq, {"lsn": seq, "op": "rows", "rows": wal_rows}
                 )
             ts = self._tiers
-            delta = DeltaTier(seq, idx, pruner=pruner)
+            delta = DeltaTier(seq, idx)
             self._tiers = TierSet(ts.epoch + 1, ts.base, ts.deltas + (delta,),
                                   base_pruner=ts.base_pruner)
+            for cb in self._listeners:
+                cb(("rows", seq, idx))
 
     # -- compaction --------------------------------------------------------
 

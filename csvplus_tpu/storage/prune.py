@@ -370,8 +370,11 @@ def build_pruner(
 class PruneDirectory:
     """One TierSet's pruners, aggregated for vectorized probing.
 
-    Built EAGERLY at TierSet construction (under the writer lock), so
-    the read path touches only immutable state — the THREAD001 probe
+    Built LAZILY by ``TierSet.prune_directory()`` on the first probe
+    after a swap (double-checked under the per-TierSet lock, with each
+    delta's TierPruner cached on its DeltaTier so successor epochs
+    reuse it) — the append path pays no per-seal scan, and every probe
+    after the first touches only immutable state, the THREAD001 probe
     contract.  Filter bitsets concatenate into one uint8 array with
     per-tier bit offsets; a probe batch then answers every
     (probe, tier) filter test in one numpy broadcast.  Tiers without a

@@ -1,0 +1,21 @@
+"""Live materialized views: incremental maintenance of verified plans
+over mutable indexes (ISSUE 12).
+
+See :mod:`.view` for the maintenance machinery and :mod:`.rules` for
+the delta-rule gate deciding which plan shapes are registrable;
+docs/VIEWS.md is the narrative companion.  The serving integration
+(registration on the LookupServer, refresh ordered after the cycle's
+writes, per-view metrics cells) lives in :mod:`csvplus_tpu.serve`.
+"""
+
+from .rules import DELTA_OPS, ViewRejected, check_view_plan
+from .view import MaterializedView, ViewSnapshot, reroot_plan
+
+__all__ = [
+    "DELTA_OPS",
+    "MaterializedView",
+    "ViewRejected",
+    "ViewSnapshot",
+    "check_view_plan",
+    "reroot_plan",
+]

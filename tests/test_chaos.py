@@ -640,3 +640,70 @@ def test_wal_crash_restart_upsert_mode(tmp_path):
     child = _load_crash_child()
     ref = child.replay_reference(acked["ops"], mode="upsert")
     assert index_checksums(mi.to_index()) == index_checksums(ref.to_index())
+
+
+# -- views: refresh crash window (ISSUE 12) ---------------------------------
+
+
+def test_view_refresh_crash_leaves_snapshot_served():
+    """A ``views:refresh`` death inside the dispatch cycle: the
+    dispatcher survives (the failure is counted per-view, never
+    propagated), readers keep the prior epoch-pinned snapshot, the
+    events stay queued, and the next cycle's disarmed retry converges
+    the view to from-scratch parity."""
+    from csvplus_tpu import plan as P
+    from csvplus_tpu.index import create_index
+    from csvplus_tpu.row import Row
+    from csvplus_tpu.source import take_rows
+    from csvplus_tpu.storage import MutableIndex
+
+    cust = create_index(
+        take_rows([Row({"cust_id": f"c{i:03d}", "name": f"n{i:03d}"})
+                   for i in range(16)]),
+        ["cust_id"],
+    )
+    cust.on_device("cpu")
+    mi = MutableIndex.create(
+        take_rows([Row({"oid": f"o{i:04d}", "cust_id": f"c{i % 16:03d}"})
+                   for i in range(200)]),
+        ["oid"],
+        ingest_device="cpu",
+    )
+    root = P.Join(P.Scan(None), cust, ("cust_id",))
+    with LookupServer(indexes={"orders": mi}) as srv:
+        view = srv.register_view("enriched", root, source="orders")
+        snap0, epoch0 = view.snapshot(), view.epoch
+        base_cs = view.checksums()
+        with faults.active(
+            FaultPlan(
+                [{"site": "views:refresh", "at": [0], "error": "fatal"}],
+                seed=17,
+            )
+        ) as plan:
+            fa = srv.submit_append(
+                [{"oid": f"o9{j:03d}", "cust_id": "c003"} for j in range(3)],
+                index="orders",
+            )
+            fd = srv.submit_delete(("o0007",), index="orders")
+            assert fa.result(timeout=30.0) == 3
+            assert fd.result(timeout=30.0) == 1
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                if srv.snapshot()["by_view"]["enriched"]["failures"] >= 1:
+                    break
+                time.sleep(0.005)
+            # the crashed refresh took nothing down with it: prior
+            # snapshot live, epoch unmoved, every event still queued
+            assert view.snapshot() is snap0 and view.epoch == epoch0
+            assert view.checksums() == base_cs
+            assert view.pending >= 1
+        # dispatcher alive — and this disarmed cycle retries the refresh
+        assert [dict(r) for r in srv.lookup("o0003", index="orders")]
+        deadline = time.time() + 30.0
+        while view.pending and time.time() < deadline:
+            time.sleep(0.005)
+        assert view.pending == 0
+        assert view.checksums() == view.recompute_checksums()
+        assert view.read("o0007") == []
+        assert len(view.read("o9001")) == 1
+        assert plan.snapshot()["fired"]["views:refresh"] == 1

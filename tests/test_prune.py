@@ -239,8 +239,10 @@ def test_pruned_reads_bitwise_equal_unpruned(mode, monkeypatch):
     monkeypatch.setenv("CSVPLUS_LSM_PRUNE", "0")
     mi_off = _mk_layered(mode)
     monkeypatch.delenv("CSVPLUS_LSM_PRUNE")
-    assert mi_on.tiers().prune_dir is not None
-    assert mi_off.tiers().prune_dir is None
+    # the directory builds lazily on the first probe (ISSUE 12
+    # satellite: appends no longer pay the per-seal scan)
+    assert mi_on.tiers().prune_directory() is not None
+    assert mi_off.tiers().prune_directory() is None
 
     def blocks(m):
         return [

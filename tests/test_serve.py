@@ -545,3 +545,52 @@ def test_served_reads_during_compaction_bitwise_equal(served):
     assert not errs, errs[0]
     assert all(out)
     assert mi.delta_count == 0  # the compactor really ran
+
+
+def test_same_cycle_delete_append_apply_in_submission_order(served):
+    """The ISSUE 12 write-ordering regression: a delete() and an
+    append() for the SAME key drained into one dispatch cycle apply in
+    submission order — delete-then-append resurrects the key,
+    append-then-delete removes it — and both land before the cycle's
+    view refresh.  A large tick forces each pair into one batch."""
+    from csvplus_tpu.storage import index_checksums, rebuild_reference
+
+    idx, ids = served
+    mi = _mutable(n=50)
+    with LookupServer(idx, indexes={"mut": mi}, tick_us=100_000) as srv:
+        # delete first, then re-append: the key must survive with the
+        # NEW value (submission order, not append-runs-first)
+        f1 = srv.submit_delete(("k003",), index="mut")
+        f2 = srv.submit_append([{"k": "k003", "v": "fresh"}], index="mut")
+        assert f1.result(timeout=30.0) == 1
+        assert f2.result(timeout=30.0) == 1
+        got = srv.lookup("k003", index="mut")
+        assert [r["v"] for r in got] == ["fresh"]
+
+        # append first, then delete: the key must be gone
+        f3 = srv.submit_append([{"k": "zz9", "v": "doomed"}], index="mut")
+        f4 = srv.submit_delete(("zz9",), index="mut")
+        assert f3.result(timeout=30.0) == 1
+        assert f4.result(timeout=30.0) == 1
+        assert srv.lookup("zz9", index="mut") == []
+
+        # interleaved run coalescing: append runs flush before each
+        # delete, and the cycle still lands as ONE wal_sync batch
+        epoch0 = mi.epoch
+        fs = [
+            srv.submit_append([{"k": "mix", "v": "a"}], index="mut"),
+            srv.submit_delete(("mix",), index="mut"),
+            srv.submit_append([{"k": "mix", "v": "b"}], index="mut"),
+        ]
+        for f in fs:
+            f.result(timeout=30.0)
+        got = srv.lookup("mix", index="mut")
+        assert [r["v"] for r in got] == ["b"]
+        snap = srv.snapshot()
+    cell = snap["by_index"]["mut"]
+    assert cell["delete_reqs"] == 3
+    assert cell["append_reqs"] == 4
+    # the replayed reference (acked op order) agrees bitwise
+    assert index_checksums(mi.to_index()) == index_checksums(
+        rebuild_reference(mi)
+    )

@@ -1,0 +1,421 @@
+"""Live materialized views (csvplus_tpu.views, docs/VIEWS.md — ISSUE 12).
+
+Contracts under test:
+
+* the hard parity contract — after EVERY applied batch the view's
+  positional per-column checksums equal a from-scratch execution of
+  the registered plan over the source's merged stream, including
+  through random append/delete interleavings, delete-then-reappend
+  resurrection, and deletes folded through leveled compaction;
+* zero warm recompiles — once one batch has warmed the per-tier
+  executable, further fixed-shape batches refresh without a single new
+  lowering (kernel counters AND the plan cache's ``lowered``);
+* the delta-rule gate — every unmaintainable shape raises
+  :class:`ViewRejected` typed at registration, with a diagnostic
+  naming the offending stage;
+* crash-safety of refresh — a fault at ``views:refresh`` leaves the
+  prior epoch-pinned snapshot live and the events queued; the retry
+  converges to parity;
+* the serving integration — registration gates, refresh ordered after
+  the cycle's writes, sub-ms snapshot reads, per-view metrics cells.
+"""
+
+import random
+
+import pytest
+
+from csvplus_tpu import plan as P
+from csvplus_tpu.exprs import Rename, SetValue, Update
+from csvplus_tpu.index import create_index
+from csvplus_tpu.obs.recompile import RecompileWatch
+from csvplus_tpu.predicates import Like
+from csvplus_tpu.resilience.faults import FaultPlan, InjectedWorkerCrash, active
+from csvplus_tpu.row import Row
+from csvplus_tpu.serve.plancache import PlanCache
+from csvplus_tpu.source import take_rows
+from csvplus_tpu.storage import MutableIndex
+from csvplus_tpu.views import MaterializedView, ViewRejected, check_view_plan
+
+N_CUST, N_PROD = 20, 8
+
+
+def _order(i, cust=None, prod=None):
+    return Row({
+        "oid": f"o{i:05d}",
+        "cust_id": cust if cust is not None else f"c{i % N_CUST:03d}",
+        "prod_id": prod if prod is not None else f"p{i % N_PROD:03d}",
+    })
+
+
+def _dims():
+    cust = create_index(
+        take_rows([Row({"cust_id": f"c{i:03d}", "name": f"n{i:03d}"})
+                   for i in range(N_CUST)]),
+        ["cust_id"],
+    )
+    cust.on_device("cpu")
+    prod = create_index(
+        take_rows([Row({"prod_id": f"p{i:03d}", "label": f"l{i:03d}"})
+                   for i in range(N_PROD)]),
+        ["prod_id"],
+    )
+    prod.on_device("cpu")
+    return cust, prod
+
+
+def _source(n=64, mode="append"):
+    return MutableIndex.create(
+        take_rows([_order(i) for i in range(n)]), ["oid"],
+        mode=mode, ingest_device="cpu",
+    )
+
+
+def _threeway(cust, prod):
+    # the headline shape: orders x customers x products
+    return P.Join(P.Join(P.Scan(None), cust, ("cust_id",)), prod, ("prod_id",))
+
+
+def _parity(view):
+    assert view.checksums() == view.recompute_checksums()
+
+
+# ---------------------------------------------------------------------------
+# registration gate
+# ---------------------------------------------------------------------------
+
+
+def test_rejected_shapes_raise_typed_at_registration():
+    cust, prod = _dims()
+    mi = _source(8)
+    scan = P.Scan(None)
+    join = _threeway(cust, prod)
+    cases = [
+        (P.Top(join, 5), "Top"),
+        (P.DropRows(join, 2), "DropRows"),
+        (P.TakeWhile(join, Like({"oid": "o00000"})), "TakeWhile"),
+        (P.DropWhile(join, Like({"oid": "o00000"})), "DropWhile"),
+        (P.Validate(join, Like({"oid": "o00000"}), "boom"), "Validate"),
+        # source key must survive to the output, else deletes can't
+        # address the emitted rows
+        (P.SelectCols(join, ("name", "label")), "projects away"),
+        (P.DropCols(join, ("oid",)), "drops source key"),
+        (P.MapExpr(scan, Rename({"oid": "order_id"})), "Rename touches"),
+        (P.MapExpr(scan, SetValue("oid", "X")), "SetValue overwrites"),
+        (P.MapExpr(scan, Update(SetValue("note", "y"), SetValue("oid", "X"))),
+         "SetValue overwrites"),
+    ]
+    for bad, needle in cases:
+        with pytest.raises(ViewRejected, match=needle) as ei:
+            MaterializedView("v", bad, mi)
+        assert ei.value.diagnostics  # typed, with per-stage diagnostics
+    # a mutable build side has no frozen-dimension delta rule
+    with pytest.raises(ViewRejected, match="MutableIndex"):
+        check_view_plan(P.Join(scan, _source(8), ("oid",)), ["oid"])
+    # upsert sources retract implicitly — no multiset algebra
+    with pytest.raises(ViewRejected, match="upsert"):
+        check_view_plan(join, ["oid"], mode="upsert")
+    # a Lookup leaf pins data-dependent bounds to one frozen table
+    with pytest.raises(ViewRejected, match="Lookup"):
+        check_view_plan(
+            P.Filter(P.Lookup(None, 0, 4), Like({"oid": "o00001"})), ["oid"]
+        )
+    # a rejected registration must not leave a dangling subscription
+    assert mi._listeners == ()
+
+
+def test_accepted_shapes_pass_the_gate():
+    cust, prod = _dims()
+    ok = P.MapExpr(
+        P.Filter(_threeway(cust, prod), Like({"prod_id": "p001"})),
+        Update(Rename({"label": "product"}), SetValue("src", "live")),
+    )
+    check_view_plan(ok, ["oid"])  # does not raise
+    check_view_plan(P.Except(P.Scan(None), cust, ("cust_id",)), ["oid"])
+
+
+# ---------------------------------------------------------------------------
+# incremental maintenance: parity after every batch
+# ---------------------------------------------------------------------------
+
+
+def test_initial_snapshot_parity_and_read():
+    cust, prod = _dims()
+    mi = _source(64)
+    view = MaterializedView("v", _threeway(cust, prod), mi)
+    _parity(view)
+    assert view.snapshot().nrows == 64
+    got = view.read("o00007")
+    assert len(got) == 1
+    assert got[0]["name"] == f"n{7 % N_CUST:03d}"
+    assert got[0]["label"] == f"l{7 % N_PROD:03d}"
+    assert view.read("zzz") == []
+
+
+def test_append_delete_resurrect_parity_each_step():
+    cust, prod = _dims()
+    mi = _source(32)
+    view = MaterializedView("v", _threeway(cust, prod), mi)
+    epoch0 = view.epoch
+
+    mi.append_rows([_order(100 + j) for j in range(5)])
+    assert view.pending == 1
+    assert view.refresh() == 1
+    _parity(view)
+    assert view.epoch == epoch0 + 1
+    assert len(view.read("o00100")) == 1
+
+    # delete an original AND a fresh row; both disappear
+    mi.delete(("o00003",))
+    mi.delete(("o00102",))
+    assert view.refresh() == 2
+    _parity(view)
+    assert view.read("o00003") == [] and view.read("o00102") == []
+
+    # resurrection: re-append a deleted key — the newer segment is
+    # untouched by the older tombstone
+    mi.append_rows([_order(3, cust="c001", prod="p001")])
+    view.refresh()
+    _parity(view)
+    got = view.read("o00003")
+    assert [r["name"] for r in got] == ["n001"]
+
+    # append-mode multiset: duplicate keys both live, in tier order
+    mi.append_rows([_order(3, cust="c002", prod="p002")])
+    view.refresh()
+    _parity(view)
+    assert [r["name"] for r in view.read("o00003")] == ["n001", "n002"]
+
+
+def test_filter_map_chain_view_parity():
+    cust, prod = _dims()
+    root = P.MapExpr(
+        P.Filter(_threeway(cust, prod), Like({"prod_id": "p002"})),
+        SetValue("src", "live"),
+    )
+    mi = _source(48)
+    view = MaterializedView("v", root, mi)
+    _parity(view)
+    assert all(r["src"] == "live" for r in view.rows())
+    mi.append_rows([_order(200, prod="p002"), _order(201, prod="p003")])
+    view.refresh()
+    _parity(view)
+    assert len(view.read("o00200")) == 1  # passed the filter
+    assert view.read("o00201") == []      # filtered out, still parity
+    mi.delete(("o00200",))
+    view.refresh()
+    _parity(view)
+    assert view.read("o00200") == []
+
+
+def test_parity_through_leveled_compaction():
+    """Compactions rewrite physical tiers but fire NO events — the
+    view's segment replay stays a faithful image of the acked stream,
+    deletes folded through leveled merges included."""
+    cust, prod = _dims()
+    mi = _source(32)
+    view = MaterializedView("v", _threeway(cust, prod), mi)
+    for j in range(6):
+        mi.append_rows([_order(300 + 10 * j + k) for k in range(3)])
+        mi.delete((f"o{300 + 10 * j:05d}",))
+    view.refresh()
+    _parity(view)
+    pend0, epoch0 = view.pending, view.epoch
+    while mi.compact_step() is not None:
+        assert view.pending == pend0  # no events from compaction
+        _parity(view)
+    mi.compact_once()
+    assert view.pending == pend0 and view.epoch == epoch0
+    _parity(view)
+    assert view.read(f"o{300:05d}") == []
+
+
+@pytest.mark.parametrize("seed", [7, 1912])
+def test_property_random_interleavings_hold_parity(seed):
+    """Seeded property harness: random append/delete interleavings —
+    resurrections, duplicate keys, deletes of never-present keys,
+    interleaved compaction steps — hold bitwise parity at EVERY step."""
+    rng = random.Random(seed)
+    cust, prod = _dims()
+    mi = _source(16)
+    view = MaterializedView("v", _threeway(cust, prod), mi)
+    pool = [f"o{i:05d}" for i in range(24)]  # overlaps the initial 16
+    for step in range(30):
+        op = rng.random()
+        if op < 0.55:
+            batch = [
+                _order(int(rng.choice(pool)[1:]),
+                       cust=f"c{rng.randrange(N_CUST):03d}",
+                       prod=f"p{rng.randrange(N_PROD):03d}")
+                for _ in range(rng.randrange(1, 5))
+            ]
+            mi.append_rows(batch)
+        elif op < 0.9:
+            mi.delete((rng.choice(pool),))
+        else:
+            mi.compact_step()
+        view.refresh()
+        _parity(view)
+    mi.compact_once()
+    _parity(view)
+    # the reads agree with a host replay of the acked stream
+    for key in rng.sample(pool, 6):
+        expect = [r for r in view.rows() if r["oid"] == key]
+        assert view.read(key) == expect
+
+
+# ---------------------------------------------------------------------------
+# zero warm recompiles
+# ---------------------------------------------------------------------------
+
+
+def test_view_refresh_zero_warm_recompiles():
+    """Fixed-shape batches after one warmup refresh trigger ZERO new
+    lowerings — kernel counters and the plan cache's ``lowered`` both
+    flat.  Parity checks run outside the watch (recompute executes at
+    a different table shape by design)."""
+    cust, prod = _dims()
+    pc = PlanCache()
+    mi = _source(64)
+    view = MaterializedView("v", _threeway(cust, prod), mi, plancache=pc)
+    B = 8
+
+    def batch(base):
+        # deterministic per-batch dictionary cardinalities: exactly B
+        # unique values per column, fixed string widths
+        return [_order(1000 + base + j,
+                       cust=f"c{(base + j) % N_CUST:03d}",
+                       prod=f"p{(base + j) % N_PROD:03d}")
+                for j in range(B)]
+
+    mi.append_rows(batch(0))  # warmup: compiles the per-tier shape
+    view.refresh()
+    with RecompileWatch(plancache=pc) as watch:
+        for i in range(1, 5):
+            mi.append_rows(batch(i * B))
+            if i == 3:
+                mi.delete((f"o{1000 + B:05d}",))  # retraction: host-only
+            assert view.refresh() >= 1
+        watch.assert_zero()
+    _parity(view)
+
+
+# ---------------------------------------------------------------------------
+# crash-safety: the views:refresh fault site
+# ---------------------------------------------------------------------------
+
+
+def test_refresh_fault_leaves_prior_snapshot_and_retries():
+    cust, prod = _dims()
+    mi = _source(32)
+    view = MaterializedView("v", _threeway(cust, prod), mi)
+    before = view.checksums()
+    snap0, epoch0 = view.snapshot(), view.epoch
+    mi.append_rows([_order(400 + j) for j in range(4)])
+    mi.delete(("o00001",))
+    with active(FaultPlan([
+        {"site": "views:refresh", "at": [0], "error": "crash"},
+    ])):
+        with pytest.raises(InjectedWorkerCrash):
+            view.refresh()
+        # prior epoch-pinned snapshot still live, nothing applied,
+        # every event still queued
+        assert view.snapshot() is snap0 and view.epoch == epoch0
+        assert view.checksums() == before
+        assert view.pending == 2
+        # the retry (the plan fires only at hit 0) converges
+        assert view.refresh() == 2
+    _parity(view)
+    assert view.pending == 0
+    assert view.read("o00001") == []
+
+
+def test_refresh_fault_mid_queue_keeps_failing_event():
+    """A crash AFTER some events applied: the applied prefix is live
+    (per-event snapshot swaps), the failing event and its successors
+    stay queued, and the retry completes exactly the remainder."""
+    cust, prod = _dims()
+    mi = _source(16)
+    view = MaterializedView("v", _threeway(cust, prod), mi)
+    mi.append_rows([_order(500)])
+    view.refresh()
+    _parity(view)
+    mi.append_rows([_order(501)])
+    mi.append_rows([_order(502)])
+    with active(FaultPlan([
+        {"site": "views:refresh", "at": [0], "error": "io"},
+    ])):
+        with pytest.raises(Exception):
+            view.refresh()
+        assert view.pending == 2
+        assert view.refresh() == 2
+    _parity(view)
+    assert len(view.read("o00502")) == 1
+
+
+# ---------------------------------------------------------------------------
+# serving integration
+# ---------------------------------------------------------------------------
+
+
+def _server_with_view():
+    from csvplus_tpu.serve import LookupServer
+
+    cust, prod = _dims()
+    mi = _source(64)
+    srv = LookupServer(indexes={"orders": mi})
+    view = srv.register_view("enriched", _threeway(cust, prod), source="orders")
+    return srv, view, mi
+
+
+def test_server_registration_gates_and_routes():
+    from csvplus_tpu.serve import LookupServer
+
+    srv, view, mi = _server_with_view()
+    assert srv.view_names() == ["enriched"]
+    assert srv.view("enriched") is view
+    with pytest.raises(KeyError, match="no view registered"):
+        srv.view("nope")
+    cust, prod = _dims()
+    with pytest.raises(ViewRejected, match="Top"):
+        srv.register_view("bad", P.Top(_threeway(cust, prod), 3),
+                          source="orders")
+    # an immutable source has no tier-event stream
+    imm = create_index(take_rows([_order(i) for i in range(4)]), ["oid"])
+    srv2 = LookupServer(imm)
+    with pytest.raises(TypeError, match="not a MutableIndex"):
+        srv2.register_view("v", _threeway(cust, prod))
+
+
+def test_server_refresh_after_writes_and_metrics():
+    srv, view, mi = _server_with_view()
+    _parity(view)
+    with srv:
+        fs = [srv.submit_append([_order(600 + j)], index="orders")
+              for j in range(3)]
+        fd = srv.submit_delete(("o00600",), index="orders")
+        for f in fs:
+            assert f.result(timeout=30.0) == 1
+        assert fd.result(timeout=30.0) == 1
+        # refresh is ordered inside the dispatch cycle right after its
+        # writes; drain any cycle still in flight, then verify
+        import time
+        deadline = time.time() + 10.0
+        while view.pending and time.time() < deadline:
+            time.sleep(0.005)
+        assert view.pending == 0
+        _parity(view)
+        assert view.read("o00600") == []
+        assert len(view.read("o00601")) == 1
+        snap = srv.snapshot()
+    cell = snap["by_view"]["enriched"]
+    assert cell["refreshes"] >= 1
+    # appends drained in one cycle coalesce into ONE tier event, so
+    # the floor is 2 (>= one rows event + the tomb event), while every
+    # appended row is accounted as probed
+    assert cell["events"] >= 2
+    assert cell["rows_probed"] >= 3
+    assert cell["rows_retracted"] >= 1
+    assert cell["reads"] == 2
+    assert cell["failures"] == 0
+    assert cell["epoch"] == view.epoch
+    assert snap["by_index"]["orders"]["delete_reqs"] == 1
