@@ -1,15 +1,16 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal bench-view trace-smoke chaos check dryrun example coldcheck lint analyze asan
+.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal bench-view trace-smoke obs-smoke chaos check dryrun example coldcheck lint analyze asan
 
 test:
 	python -m pytest tests/ -x -q
 
 # The standing local gate: unit suite, static analysis, chaos
-# differential, mutable-index storage bench, materialized-view bench —
-# the set a change must keep green before review.
-check: test lint chaos bench-delta bench-wal bench-view
+# differential, mutable-index storage bench, materialized-view bench,
+# telemetry-plane smoke — the set a change must keep green before
+# review.
+check: test lint chaos bench-delta bench-wal bench-view obs-smoke
 
 # Static analysis gate (docs/ANALYSIS.md).  The repo AST lint (ctypes
 # boundary + jit retrace rules) always runs; ruff and mypy run when
@@ -142,6 +143,17 @@ bench-view:
 trace-smoke:
 	JAX_PLATFORMS=cpu python bench.py --trace-smoke
 
+# Telemetry-plane smoke (docs/OBSERVABILITY.md): a served pass with a
+# planted Zipf heavy hitter must surface that key in the Prometheus
+# scrape's csvplus_skew_topk series (scraped over real HTTP from the
+# plane's endpoint), the tail sampler must retain only its bounded
+# slice, the metric surface must carry serve/index/process families,
+# zero warm recompiles — and the plane's per-request overhead must be
+# <=2% of the bare serving pass (CSVPLUS_OBS_SMOKE_MAX_PCT to
+# override).  One JSON line; exits nonzero on any gate failure.
+obs-smoke:
+	JAX_PLATFORMS=cpu python bench.py --obs-smoke
+
 # Fault-injection differential gate (docs/RESILIENCE.md): seeded fault
 # schedules against serve load, K-worker streamed ingest, and the
 # 8-way mesh join.  Recoverable faults must yield bitwise-equal
@@ -151,8 +163,10 @@ trace-smoke:
 # a failure; the DISARMED injection hooks must cost <=1% of a served
 # request.  Also covers the views:refresh crash window (a dead view
 # refresh leaves the prior epoch-pinned snapshot served and retries).
-# Writes CHAOS_r12.json; the unit-level chaos suite
-# (tests/test_chaos.py) runs first.
+# The ISSUE 13 extension asserts both crash windows leave a parseable
+# flight-recorder dump naming the firing fault site.  Writes
+# CHAOS_r13.json; the unit-level chaos suite (tests/test_chaos.py)
+# runs first.
 chaos:
 	JAX_PLATFORMS=cpu timeout -k 10 600 python -m pytest tests/test_chaos.py -q
 	timeout -k 10 600 python chaos.py

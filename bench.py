@@ -1279,6 +1279,192 @@ def _trace_smoke() -> int:
     return 0
 
 
+def _obs_smoke() -> int:
+    """The `make obs-smoke` tier: the telemetry plane end-to-end on the
+    micro lookup shape, seconds, hermetic CPU.
+
+    Four gates, ONE JSON line on stdout, nonzero exit on any failure:
+
+    1. a served pass with Zipf-skewed probes must surface the planted
+       heavy hitter in the Prometheus scrape's ``csvplus_skew_topk``
+       series — scraped over REAL HTTP from the plane's endpoint, not
+       read from the registry in-process;
+    2. the scrape must carry the serve / index / tail / flight /
+       process metric families (the always-on surface an operator
+       would dashboard);
+    3. zero warm recompiles across the telemetered warm pass
+       (``RecompileWatch.assert_zero`` — the plane must not perturb
+       the compile caches);
+    4. the always-on hook cost (per-probe sketch offer + per-cycle
+       ``on_cycle``) scaled by the counts the served pass actually
+       recorded must stay under ``CSVPLUS_OBS_SMOKE_MAX_PCT`` (default
+       2%) of the bare batched lookup pass — the trace-smoke
+       discipline applied to the metrics plane.
+    """
+    import urllib.request
+
+    import numpy as np
+
+    import csvplus_tpu as cp
+    from csvplus_tpu.columnar.table import DeviceTable
+    from csvplus_tpu.obs.memory import host_header
+    from csvplus_tpu.obs.metrics import (
+        MetricRegistry,
+        TelemetryPlane,
+    )
+    from csvplus_tpu.obs.flight import FlightRecorder
+    from csvplus_tpu.obs.recompile import RecompileWatch
+    from csvplus_tpu.serve import LookupServer
+
+    n = int(os.environ.get("CSVPLUS_OBS_SMOKE_ROWS", 100_000))
+    n_probes = int(os.environ.get("CSVPLUS_OBS_SMOKE_PROBES", 2_000))
+    n_requests = 64
+    max_pct = float(os.environ.get("CSVPLUS_OBS_SMOKE_MAX_PCT", 2.0))
+    ids = np.arange(n, dtype=np.int64) * 7 % (n * 3)
+    keys = np.char.add("c", ids.astype(np.str_))
+    t = DeviceTable.from_pylists(
+        {"cust_id": keys.tolist(), "v": np.arange(n).astype(np.str_).tolist()},
+        device="cpu",
+    )
+    idx = cp.take(t).index_on("cust_id").sync()
+    draws = zipf_probe_values(ids, n_probes)
+    probes = [f"c{int(v)}" for v in draws]
+    # the planted heavy hitter: the empirically most frequent key of
+    # the 64 draws the served pass will actually submit
+    vals, counts = np.unique(draws[:n_requests], return_counts=True)
+    hitter = f"c{int(vals[counts.argmax()])}"
+    _ = cp.to_rows_many(idx.find_many(probes[:10]))  # warm dispatch
+
+    # bare pass: the engine with no serving tier and no plane hooks
+    t_pass = float("inf")
+    for _rep in range(3):
+        t0 = time.perf_counter()
+        cp.to_rows_many(idx.find_many(probes))
+        t_pass = min(t_pass, time.perf_counter() - t0)
+
+    srv = LookupServer(idx)
+    srv.start()
+    try:
+        # cold pass compiles; the watched warm pass must not
+        for p in probes[:8]:
+            srv.submit(p).result(timeout=60)
+        watch = RecompileWatch().__enter__()
+        futs = [srv.submit(p) for p in probes[:n_requests]]
+        for f in futs:
+            f.result(timeout=60)
+        recompiles = watch.delta()
+        if recompiles:
+            sys.stderr.write(
+                f"obs-smoke FAILED: warm recompiles {recompiles}\n"
+            )
+            return 1
+
+        # the scrape, over real HTTP
+        port = srv.plane.serve_http()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+        want_families = (
+            "csvplus_serve_completed_total",
+            "csvplus_serve_cycles_total",
+            "csvplus_serve_latency_ms",
+            'csvplus_index_lookups{index="default"}',
+            "csvplus_tail_offered_total",
+            "csvplus_flight_events",
+            "csvplus_process_peak_rss_mb",
+            "csvplus_skew_observed_total",
+        )
+        missing = [w for w in want_families if w not in text]
+        if missing:
+            sys.stderr.write(
+                f"obs-smoke FAILED: scrape missing {missing}\n"
+            )
+            return 1
+        topk_lines = [
+            ln for ln in text.splitlines()
+            if ln.startswith("csvplus_skew_topk{")
+        ]
+        hit_lines = [
+            ln for ln in topk_lines
+            if f'key="{hitter}"' in ln and 'side="probe"' in ln
+        ]
+        if not hit_lines:
+            sys.stderr.write(
+                f"obs-smoke FAILED: heavy hitter {hitter} not in "
+                f"csvplus_skew_topk ({len(topk_lines)} top-K lines)\n"
+            )
+            return 1
+
+        # always-on hook cost, measured directly on a scratch plane and
+        # scaled by the counts the served pass recorded
+        plane_snap = srv.plane.registry.sample_dict()
+        cycles = int(plane_snap.get("csvplus_serve_cycles_total", 0))
+        observed = int(
+            plane_snap.get(
+                'csvplus_skew_observed_total{index="default",side="probe"}',
+                0,
+            )
+        )
+    finally:
+        srv.plane.close()
+        srv.stop()
+
+    scratch = TelemetryPlane(
+        registry=MetricRegistry(), flight_recorder=FlightRecorder()
+    )
+    reps = 20_000
+    # the dispatcher calls offer_probes ONCE per cycle with the whole
+    # sub-batch — measure that call shape, not a per-probe call
+    avg_batch = max(1, observed // max(1, cycles))
+    batch_probes = [("c1",)] * avg_batch
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        scratch.offer_probes("default", batch_probes)
+    per_offer_call = (time.perf_counter() - t0) / reps
+    sample = (0.001, 0.0001, "ok", "lookup", "default", None)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        scratch.on_cycle(avg_batch, 0.001, [sample] * avg_batch)
+    per_cycle = (time.perf_counter() - t0) / reps
+    hooks_s = cycles * (per_cycle + per_offer_call)
+    overhead_pct = 100.0 * hooks_s / t_pass
+
+    record = {
+        "metric": "obs_smoke",
+        "value": round(overhead_pct, 4),
+        "unit": "pct_always_on_overhead",
+        "max_pct": max_pct,
+        "heavy_hitter": hitter,
+        "hitter_in_topk": True,
+        "topk_series": len(topk_lines),
+        "cycles": cycles,
+        "probes_sketched": observed,
+        "avg_batch": avg_batch,
+        "per_offer_call_ns": round(per_offer_call * 1e9, 1),
+        "per_cycle_ns": round(per_cycle * 1e9, 1),
+        "warm_recompiles": 0,
+        "bare_pass_ms": round(t_pass * 1e3, 3),
+        "n_rows": n,
+        "n_probes": n_probes,
+        **host_header(),
+    }
+    print(json.dumps(record), flush=True)
+    if overhead_pct > max_pct:
+        sys.stderr.write(
+            f"obs-smoke FAILED: always-on overhead {overhead_pct:.3f}%"
+            f" > {max_pct}% budget\n"
+        )
+        return 1
+    sys.stderr.write(
+        f"obs-smoke ok: hitter {hitter} in top-K ({len(topk_lines)}"
+        f" series), {cycles} cycles / {observed} probes sketched,"
+        f" always-on overhead {overhead_pct:.4f}% (budget {max_pct}%),"
+        f" zero warm recompiles\n"
+    )
+    return 0
+
+
 def _bench_mesh() -> int:
     """The `make bench-mesh` tier: the sharded north-star pipeline on
     the virtual 8-device CPU mesh, with the same floor contract as
@@ -1722,4 +1908,10 @@ if __name__ == "__main__":
         # overhead budget — hermetic CPU
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sys.exit(_trace_smoke())
+    if "--obs-smoke" in sys.argv:
+        # telemetry-plane smoke: Prometheus scrape over HTTP, planted
+        # Zipf heavy hitter in top-K, always-on overhead budget, zero
+        # warm recompiles — hermetic CPU
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.exit(_obs_smoke())
     main()

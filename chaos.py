@@ -27,20 +27,27 @@ every unapplied tier event queued, and the dispatcher alive — and the
 disarmed retry must converge the view back to bitwise parity with a
 from-scratch execution of its registered plan.
 
+The ISSUE 13 extension asserts the crash FLIGHT RECORDER on the two
+terminal windows: a dispatcher crash and a ``views:refresh`` crash
+must each leave an atomically-written flight dump that parses and
+names the firing fault site in its event timeline.
+
 Contract (matches the benches): diagnostics go to stderr, stdout
-carries ONE compact JSON line; CHAOS_r12.json records the full
+carries ONE compact JSON line; CHAOS_r13.json records the full
 evidence — per-case injection counts (``FaultPlan.snapshot``), recovery
 outcomes, serve retry/degrade metrics, telemetry counters
-(``ingest.worker_recovered``), and the overhead measurement.  Exits
-nonzero when any case fails its contract.
+(``ingest.worker_recovered``), flight-dump evidence, and the overhead
+measurement.  Exits nonzero when any case fails its contract.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -60,7 +67,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 #: Watchdog bound per chaos case: a case that cannot finish inside this
 #: is a hang, which is exactly what the resilience layer must prevent.
 CASE_TIMEOUT_S = float(os.environ.get("CSVPLUS_CHAOS_CASE_TIMEOUT", 120))
-ARTIFACT = os.path.join(REPO, "CHAOS_r12.json")
+ARTIFACT = os.path.join(REPO, "CHAOS_r13.json")
 #: Disarmed-hook budget: injection sites on the serve path may cost at
 #: most this fraction of one served request.
 OVERHEAD_BUDGET_PCT = 1.0
@@ -201,55 +208,116 @@ def case_serve_degrade(idx, ids):
     }
 
 
+@contextlib.contextmanager
+def _flight_dir():
+    """Point the crash flight recorder at a fresh scratch dir for one
+    case, restoring the prior CSVPLUS_FLIGHT_DIR on exit."""
+    d = tempfile.mkdtemp(prefix="chaos_flight_")
+    prev = os.environ.get("CSVPLUS_FLIGHT_DIR")
+    os.environ["CSVPLUS_FLIGHT_DIR"] = d
+    try:
+        yield d
+    finally:
+        if prev is None:
+            os.environ.pop("CSVPLUS_FLIGHT_DIR", None)
+        else:
+            os.environ["CSVPLUS_FLIGHT_DIR"] = prev
+
+
+def _flight_evidence(flight_dir, site, timeout_s=10.0):
+    """Parse every flight dump a crash window left in *flight_dir* and
+    report whether one names *site* as a fired fault in its timeline —
+    the ISSUE 13 post-mortem contract.  Waits out the crash thread's
+    in-flight write: futures unblock before the dump finishes."""
+    deadline = time.perf_counter() + timeout_s
+    names: list = []
+    while not names and time.perf_counter() < deadline:
+        names = sorted(
+            f for f in os.listdir(flight_dir)
+            if f.startswith("csvplus_flight.") and f.endswith(".json")
+        )
+        if not names:
+            time.sleep(0.01)
+    parsed = 0
+    named = False
+    reasons = []
+    for name in names:
+        try:
+            with open(os.path.join(flight_dir, name)) as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as err:
+            reasons.append(f"unparseable: {type(err).__name__}")
+            continue
+        parsed += 1
+        reasons.append(payload.get("reason"))
+        for ev in payload.get("events", ()):
+            if ev.get("kind") == "fault:fired" and ev.get("site") == site:
+                named = True
+    return {
+        "ok": bool(names) and parsed == len(names) and named,
+        "dumps": len(names),
+        "parsed": parsed,
+        "reasons": reasons,
+        "names_fault_site": named,
+    }
+
+
 def case_dispatcher_crash(idx, ids):
     """A fatal fault in the dispatcher: every pending future fails with
     typed ServerCrashed in under a second; post-mortem submits fail
-    fast at admission."""
+    fast at admission; the flight recorder leaves a parseable dump that
+    names the firing fault site."""
     from csvplus_tpu.resilience import faults
     from csvplus_tpu.resilience.faults import FaultPlan
     from csvplus_tpu.resilience.retry import ServerCrashed
     from csvplus_tpu.serve import LookupServer
 
-    srv = LookupServer(idx, tick_us=20_000)  # hold the doomed batch open
-    srv.start()
-    try:
-        with faults.active(
-            FaultPlan([{"site": "serve:dispatch", "at": [0], "error": "fatal"}])
-        ) as plan:
-            futs = []
-            for v in ids[:16]:
-                try:
-                    futs.append(srv.submit(f"c{int(v)}"))
-                except ServerCrashed:
-                    break
-            t0 = time.perf_counter()
-            typed = 0
-            for f in futs:
-                try:
-                    f.result(timeout=1.0)
-                except ServerCrashed:
-                    typed += 1
-                except BaseException:
-                    pass
-            unblock_s = time.perf_counter() - t0
+    with _flight_dir() as flight_dir:
+        srv = LookupServer(idx, tick_us=20_000)  # hold the doomed batch open
+        srv.start()
         try:
-            srv.submit(f"c{int(ids[0])}")
-            post_typed = False
-        except ServerCrashed:
-            post_typed = True
-        return {
-            "ok": bool(futs)
-            and typed == len(futs)
-            and unblock_s < 1.0
-            and post_typed,
-            "pending_futures": len(futs),
-            "typed_failures": typed,
-            "unblock_seconds": round(unblock_s, 4),
-            "post_crash_submit_typed": post_typed,
-            "injections": plan.snapshot(),
-        }
-    finally:
-        srv.stop()
+            with faults.active(
+                FaultPlan(
+                    [{"site": "serve:dispatch", "at": [0], "error": "fatal"}]
+                )
+            ) as plan:
+                futs = []
+                for v in ids[:16]:
+                    try:
+                        futs.append(srv.submit(f"c{int(v)}"))
+                    except ServerCrashed:
+                        break
+                t0 = time.perf_counter()
+                typed = 0
+                for f in futs:
+                    try:
+                        f.result(timeout=1.0)
+                    except ServerCrashed:
+                        typed += 1
+                    except BaseException:
+                        pass
+                unblock_s = time.perf_counter() - t0
+            try:
+                srv.submit(f"c{int(ids[0])}")
+                post_typed = False
+            except ServerCrashed:
+                post_typed = True
+            flight = _flight_evidence(flight_dir, "serve:dispatch")
+            return {
+                "ok": bool(futs)
+                and typed == len(futs)
+                and unblock_s < 1.0
+                and post_typed
+                and flight["ok"],
+                "pending_futures": len(futs),
+                "typed_failures": typed,
+                "unblock_seconds": round(unblock_s, 4),
+                "post_crash_submit_typed": post_typed,
+                "flight": flight,
+                "injections": plan.snapshot(),
+            }
+        finally:
+            srv.stop()
 
 
 # ---- K-worker streamed ingest under faults -------------------------------
@@ -573,7 +641,8 @@ def case_view_refresh_crash():
     """A fatal fault at the top of the view-refresh pass inside a
     serving write cycle: the prior epoch-pinned snapshot stays live,
     the events stay queued, the dispatcher survives — and the disarmed
-    retry converges back to from-scratch parity."""
+    retry converges back to from-scratch parity.  The crash window
+    leaves a flight dump naming the views:refresh fault site."""
     from csvplus_tpu import plan as P
     from csvplus_tpu.index import create_index
     from csvplus_tpu.resilience import faults
@@ -611,7 +680,8 @@ def case_view_refresh_crash():
     root = P.Join(
         P.Join(P.Scan(None), cust, ("cust_id",)), prod, ("prod_id",)
     )
-    with LookupServer(indexes={"orders": mi}) as srv:
+    with _flight_dir() as flight_dir, \
+            LookupServer(indexes={"orders": mi}) as srv:
         view = srv.register_view("enriched", root, source="orders")
         base_cs = view.checksums()
         snap0, epoch0 = view.snapshot(), view.epoch
@@ -653,6 +723,7 @@ def case_view_refresh_crash():
         parity = view.checksums() == view.recompute_checksums()
         resurrect_gone = view.read("o00007") == []
         cell = srv.snapshot()["by_view"]["enriched"]
+        flight = _flight_evidence(flight_dir, "views:refresh")
     return {
         "ok": acked
         and failures >= 1
@@ -661,13 +732,15 @@ def case_view_refresh_crash():
         and converged
         and parity
         and resurrect_gone
-        and injections["fired"].get("views:refresh", 0) == 1,
+        and injections["fired"].get("views:refresh", 0) == 1
+        and flight["ok"],
         "write_futures_acked": acked,
         "refresh_failures_recorded": failures,
         "prior_snapshot_intact": intact,
         "dispatcher_alive": alive,
         "retry_converged": converged,
         "from_scratch_parity": parity,
+        "flight": flight,
         "injections": injections,
         "view_cell": {
             k: cell[k] for k in ("refreshes", "events", "failures", "epoch")

@@ -11,15 +11,43 @@ diagnosis (r05 warm join, r06 mesh RSS) turned out to need it:
   registered module-level kernels (:class:`RecompileWatch`);
 * :mod:`~csvplus_tpu.obs.memory` — RSS/device-memory watermark
   sampling attachable to any span, plus the bench-artifact host header;
-* :mod:`~csvplus_tpu.obs.diff` — the stage-table regression differ
-  behind ``python -m csvplus_tpu.obs diff``.
+* :mod:`~csvplus_tpu.obs.diff` — the stage-table AND bench-record
+  regression differs behind ``python -m csvplus_tpu.obs diff``;
+* :mod:`~csvplus_tpu.obs.metrics` — the production telemetry plane
+  (ISSUE 13): typed metric registry, Prometheus text exposition +
+  optional HTTP endpoint, the JSONL metrics pump, tail-sampled request
+  tracing, and the :class:`TelemetryPlane` bundle the serving tier
+  carries;
+* :mod:`~csvplus_tpu.obs.flight` — the crash flight recorder: a
+  bounded process-global event ring dumped atomically on terminal
+  failure paths;
+* :mod:`~csvplus_tpu.obs.sketch` — the Space-Saving top-K heavy-hitter
+  sketch behind ``python -m csvplus_tpu.obs skew``.
 
 The legacy ``telemetry`` singleton keeps its API and feeds the same
 machinery: ``telemetry.stage()`` opens a span whenever a trace is
 active in the calling context.
 """
 
-from .diff import diff_files, diff_stage_tables, load_stage_table
+from .diff import (
+    diff_bench_files,
+    diff_bench_records,
+    diff_files,
+    diff_stage_tables,
+    load_stage_table,
+)
+from .flight import FlightRecorder, recorder
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    MetricsPump,
+    PromHttpEndpoint,
+    TailSampler,
+    TelemetryPlane,
+)
+from .sketch import SpaceSaving, skew_report
 from .export import (
     SpanJsonlSink,
     chrome_trace_events,
@@ -67,7 +95,21 @@ __all__ = [
     "compile_counts",
     "register_kernel",
     "registered_kernels",
+    "diff_bench_files",
+    "diff_bench_records",
     "diff_files",
     "diff_stage_tables",
     "load_stage_table",
+    "FlightRecorder",
+    "recorder",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "MetricsPump",
+    "PromHttpEndpoint",
+    "TailSampler",
+    "TelemetryPlane",
+    "SpaceSaving",
+    "skew_report",
 ]

@@ -261,6 +261,17 @@ class FaultPlan:
                     break
         if chosen is None:
             return
+        # armed firings are rare by construction — record each one in
+        # the flight ring so a post-mortem dump names the firing site
+        # (imported here, not at module top: obs is a heavier package
+        # than this leaf module and the disarmed path never needs it)
+        from ..obs import flight as _flight
+
+        _flight.note(
+            "fault:fired", site=site, fault_kind=chosen.kind,
+            error=chosen.error if chosen.kind == "raise" else None,
+            hit=hit,
+        )
         if chosen.kind == "delay":
             time.sleep(chosen.delay_s)
             return

@@ -156,7 +156,27 @@ def call_with_retry(
         try:
             return fn()
         except Exception as err:
-            if classify(err) != TRANSIENT or attempt >= pol.max_attempts:
+            kind = classify(err)
+            if kind != TRANSIENT or attempt >= pol.max_attempts:
+                if kind == FATAL:
+                    # a fatal classification is a terminal path — dump
+                    # the flight ring alongside the dispatcher-crash
+                    # and views:refresh dumps (never raises)
+                    from ..obs import flight as _flight
+
+                    _flight.note(
+                        "fatal", site=site, error=type(err).__name__,
+                        attempt=attempt,
+                    )
+                    try:
+                        _flight.dump(f"fatal:{site}", err)
+                    except Exception as dump_err:
+                        import sys
+
+                        sys.stderr.write(
+                            f"csvplus-flight: fatal-path dump failed "
+                            f"({type(dump_err).__name__}: {dump_err})\n"
+                        )
                 raise
             sleep_s = pol.next_backoff(sleep_s)
             if time_left is not None:

@@ -51,6 +51,7 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
+from ..obs.metrics import TelemetryPlane
 from ..obs.span import tracer
 from ..resilience import faults
 from ..resilience.degrade import CircuitBreaker, HostLookupOracle
@@ -162,6 +163,7 @@ class LookupServer:
         tick_us: Optional[int] = None,
         plancache: Optional[PlanCache] = None,
         metrics: Optional[ServingMetrics] = None,
+        plane: Optional[TelemetryPlane] = None,
     ):
         # registry: the positional index lands under DEFAULT_INDEX;
         # *indexes* (name -> Index | MutableIndex) adds named routes.
@@ -209,6 +211,11 @@ class LookupServer:
         self.breaker = CircuitBreaker()
         self._oracle = default.oracle
         self._crashed: Optional[ServerCrashed] = None
+        # the always-on telemetry plane (ISSUE 13): registry + tail
+        # sampler + skew sketches + the process-global flight recorder.
+        # Construction is cheap; exposition transports stay opt-in.
+        self.plane = plane if plane is not None else TelemetryPlane()
+        self.plane.attach_server(self)
 
     def register(self, name: str, index) -> None:
         """Register (or replace) a named index while running.  The
@@ -222,6 +229,15 @@ class LookupServer:
             regs = dict(self._indexes)
             regs[reg.name] = reg
             self._indexes = regs
+        if hasattr(reg.impl, "key_sketch"):
+            # late registrations get their build-key sketch too
+            reg.impl.key_sketch = self.plane.build_sketch(reg.name)
+
+    def registered(self) -> dict:
+        """Snapshot of the index registry as ``{name: impl}`` — the
+        duck-typed surface the telemetry plane's collectors walk
+        (read-amp trackers, build-key sketch installation)."""
+        return {name: reg.impl for name, reg in self._indexes.items()}
 
     def register_view(self, name: str, root, *, source: Optional[str] = None):
         """Register a live materialized view of plan *root* over the
@@ -555,7 +571,12 @@ class LookupServer:
                     self._complete(req, value, None, samples, own_dispatch=True)
         self.metrics.on_batch(len(batch))
         self.metrics.on_complete_batch(samples)
-        self.metrics.observe_dispatch(len(batch), time.perf_counter() - t0)
+        cycle_s = time.perf_counter() - t0
+        self.metrics.observe_dispatch(len(batch), cycle_s)
+        # telemetry plane: tail-sample the cycle's completion records
+        # and note the cycle summary in the flight ring — a constant
+        # number of lock rounds regardless of batch size
+        self.plane.on_cycle(len(batch), cycle_s, samples)
 
     def _run_writes(
         self, reg: _Registered, reqs: List[ServeFuture], samples: List[tuple]
@@ -641,6 +662,13 @@ class LookupServer:
                     f"({type(err).__name__}: {err}); prior snapshot "
                     f"stays live, retrying next cycle\n"
                 )
+                # post-mortem evidence for the views:refresh crash
+                # window: note + atomic flight dump (never raises)
+                self.plane.flight.note(
+                    "views:refresh-failed", view=name,
+                    error=type(err).__name__,
+                )
+                self.plane.flight_dump(f"views:refresh:{name}", err)
 
     def _run_lookups(
         self, reg: _Registered, lookups: List[ServeFuture], samples: List[tuple]
@@ -727,6 +755,9 @@ class LookupServer:
             tiers_probed=getattr(bounds, "tiers_probed", None),
             tiers_pruned=getattr(bounds, "tiers_pruned", None),
         )
+        # skew evidence: the sub-batch's probe keys into this index's
+        # Space-Saving sketch, one lock round
+        self.plane.offer_probes(reg.name, probes)
         phases = (
             ("serve:bounds", t_a, t_b),
             ("serve:gather-decode", t_b, t_c),
@@ -792,6 +823,15 @@ class LookupServer:
         for req in list(inflight) + orphans:
             self._complete(req, None, crash, samples)
         self.metrics.on_complete_batch(samples)
+        # the flight recorder's reason-to-exist: dump the last N cycle
+        # summaries, fault firings, and storage events with the crash
+        # attached (atomic tmp->fsync->rename; never raises)
+        self.plane.tail.offer_batch(samples)
+        self.plane.flight.note(
+            "serve:dispatcher-crash", error=type(err).__name__,
+            failed=len(samples),
+        )
+        self.plane.flight_dump("serve:dispatcher-crash", err)
 
     def _complete(
         self,
@@ -816,8 +856,23 @@ class LookupServer:
             if error is None
             else ("expired" if isinstance(error, DeadlineExceeded) else "failed")
         )
+        # extended completion record: the first three fields are the
+        # classic ServingMetrics shape; the tail sampler reads the
+        # rest (request kind, route, error type) when it retains one
+        kind = (
+            "plan" if req.plan is not None
+            else "write" if (req.rows is not None or req.del_key is not None)
+            else "lookup"
+        )
         samples.append(
-            (done - req.t_submit, req.t_dispatch - req.t_submit, outcome)
+            (
+                done - req.t_submit,
+                req.t_dispatch - req.t_submit,
+                outcome,
+                kind,
+                req.index_name,
+                type(error).__name__ if error is not None else None,
+            )
         )
         if req.trace_ctx is not None:
             # attribute the dispatcher's work back into the SUBMITTER's

@@ -28,6 +28,13 @@ from typing import Dict, List, Optional
 #: well below the noise of a 1-CPU host while keeping snapshots O(1)-ish.
 RESERVOIR_CAP = 4096
 
+#: ``snapshot()`` shape version.  The Prometheus exposition mapping
+#: (``csvplus_tpu.obs.metrics.serve_samples``) and the bench artifacts
+#: both consume the snapshot dict — bump this when top-level or
+#: per-index/per-view cell keys change, and update the shape-stability
+#: test pinning them (tests/test_telemetry.py).
+SNAPSHOT_SCHEMA_VERSION = 1
+
 
 class LatencyReservoir:
     """Bounded uniform reservoir of latency samples (seconds).
@@ -239,9 +246,11 @@ class ServingMetrics:
         """Record a whole dispatch cycle's deliveries in ONE lock round
         — at 100K+ lookups/s a per-request lock acquisition is a
         measurable slice of the per-key budget.  *samples* is a sequence
-        of ``(latency_s, wait_s, outcome)`` tuples."""
+        of ``(latency_s, wait_s, outcome, ...)`` tuples — trailing
+        fields (request kind, route, error type) belong to the tail
+        sampler and are ignored here."""
         with self._lock:
-            for latency_s, wait_s, outcome in samples:
+            for latency_s, wait_s, outcome, *_rest in samples:
                 self.completed += 1
                 if outcome == "expired":
                     self.expired += 1
@@ -370,6 +379,7 @@ class ServingMetrics:
         hit/miss/evict stats under ``"plancache"``."""
         with self._lock:
             out: Dict[str, object] = {
+                "schema_version": SNAPSHOT_SCHEMA_VERSION,
                 "ticks": self.ticks,
                 "enqueued": self.enqueued,
                 "completed": self.completed,

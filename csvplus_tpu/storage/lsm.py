@@ -106,6 +106,7 @@ from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..index import Index, create_index, load_index
+from ..obs import flight as _flight
 from ..resilience import faults
 from ..row import Row
 from ..source import take_rows
@@ -465,6 +466,11 @@ class MutableIndex:
         # rebuild scan — slower startup, never wrong answers.
         self._prune = prune_enabled()
         self._readamp = ReadAmpTracker()
+        # optional build-side key-skew sketch (ISSUE 13): when the
+        # telemetry plane installs a SpaceSaving here, every sealed
+        # delta's keys are offered — heavy-hitter evidence for the
+        # skew-aware join work.  None = zero overhead.
+        self.key_sketch = None
         # tier-swap listeners (the views delta feed) — a tuple swapped
         # whole under self._lock so delivery iterates immutable state
         self._listeners: Tuple = ()
@@ -948,6 +954,10 @@ class MutableIndex:
                 f"delete() needs a full-width key ({len(self._columns)} "
                 f"columns, got {len(norm)})"
             )
+        sk = self.key_sketch
+        if sk is not None:
+            # a tombstone seal is build-side key traffic too
+            sk.offer(norm[0] if len(norm) == 1 else norm)
         with self._lock:
             seq = self._next_seq
             self._next_seq += 1
@@ -987,6 +997,17 @@ class MutableIndex:
             # (replaying a stable sort of already-sorted rows rebuilds
             # the identical tier)
             wal_rows = [dict(r) for r in tier_rows(idx._impl)]
+        sk = self.key_sketch
+        if sk is not None:
+            # build-side skew evidence, offered OUTSIDE the writer lock
+            # (the sketch is its own monitor; order is immaterial)
+            cols = self._columns
+            rows = wal_rows if wal_rows is not None else tier_rows(idx._impl)
+            if len(cols) == 1:
+                col = cols[0]
+                sk.offer_many(r.get(col) for r in rows)
+            else:
+                sk.offer_many(tuple(r.get(c) for c in cols) for r in rows)
         # no seal-time summary build: the first probe after the swap
         # pays the O(n) fence+filter scan once, via
         # DeltaTier.ensure_pruner — the write path stays scan-free
@@ -1003,6 +1024,10 @@ class MutableIndex:
                                   base_pruner=ts.base_pruner)
             for cb in self._listeners:
                 cb(("rows", seq, idx))
+        _flight.note(
+            "storage:seal", seq=seq, rows=len(idx._impl),
+            deltas=len(ts.deltas) + 1,
+        )
 
     # -- compaction --------------------------------------------------------
 
@@ -1085,6 +1110,10 @@ class MutableIndex:
             _t["rows_out"] = len(merged._impl)
         if self._wal is not None:
             self._checkpoint(merged, ts.deltas[-1].seq, pruner)
+        _flight.note(
+            "storage:compact", mode="full", deltas=len(ts.deltas),
+            rows_out=len(merged._impl), seconds=round(seconds, 6),
+        )
         return {
             "kind": "full",
             "deltas": len(ts.deltas),
@@ -1137,6 +1166,10 @@ class MutableIndex:
                 self._compactions += 1
                 self._compact_seconds += seconds
             _t["rows_out"] = n_out
+        _flight.note(
+            "storage:compact", mode="partial", deltas=len(run),
+            rows_out=n_out, seconds=round(seconds, 6),
+        )
         return {
             "kind": "partial",
             "deltas": len(run),
@@ -1194,6 +1227,10 @@ class MutableIndex:
             self._base_file = base_name
         self._wal.drop_applied(int(applied_lsn))
         mf.remove_stale(directory, doc)
+        _flight.note(
+            "storage:checkpoint", checkpoint=ck,
+            applied_lsn=int(applied_lsn), base=base_name,
+        )
 
     def to_index(self) -> Index:
         """A frozen Index equal to fully compacting the CURRENT tier

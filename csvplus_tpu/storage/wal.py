@@ -55,6 +55,7 @@ import zlib
 from typing import Dict, List, Optional, Tuple
 
 from ..errors import CsvPlusError
+from ..obs import flight as _flight
 from ..resilience import faults
 
 __all__ = ["Wal", "WalError", "wal_sync_mode"]
@@ -289,6 +290,10 @@ class Wal:
             "removed_segments": removed,
             "segments": [name for _, name in list_segments(directory)],
         }
+        _flight.note(
+            "wal:recover", replayed=len(replay),
+            truncated_bytes=int(truncated), segments=len(info["segments"]),
+        )
         return w, replay, info
 
     # -- internals (caller holds self._lock) -------------------------------
@@ -387,7 +392,9 @@ class Wal:
             if self._f is None:
                 raise WalError("WAL is closed")
             self._roll_locked()
-            return _SEG_FMT % self._seg
+            name = _SEG_FMT % self._seg
+        _flight.note("wal:seal", segment=name)
+        return name
 
     def drop_applied(self, applied_lsn: int) -> List[str]:
         """Delete sealed segments wholly covered by *applied_lsn* (their

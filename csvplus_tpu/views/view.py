@@ -57,6 +57,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import plan as P
+from ..obs import flight as _flight
 from ..obs.span import tracer
 from ..resilience import faults
 from ..row import Row
@@ -279,6 +280,13 @@ class MaterializedView:
                 self._metrics.on_view_refresh(
                     self.name, events=applied, rows_probed=rows_probed,
                     rows_retracted=rows_retracted, epoch=snap.epoch,
+                )
+            if applied:
+                # view maintenance in the flight timeline, between the
+                # cycle's writes and its lookups
+                _flight.note(
+                    "views:refresh", view=self.name, events=applied,
+                    epoch=snap.epoch,
                 )
             return applied
 

@@ -168,8 +168,46 @@ def test_serve_fatal_surfaces_typed_server_survives(served):
         assert srv.snapshot()["failed"] == 1
 
 
-def test_dispatcher_crash_fails_pending_and_future_fast(served):
+def _flight_dumps(flight_dir, timeout_s=10.0):
+    """Parse every flight dump in *flight_dir* (ISSUE 13 post-mortem
+    evidence), waiting out the crash thread's in-flight write — futures
+    unblock BEFORE the dispatcher finishes its dump.  An unparseable
+    dump is an assertion failure — the atomic write contract says
+    complete-or-absent."""
+    import json
+
+    deadline = time.perf_counter() + timeout_s
+    names: list = []
+    while not names and time.perf_counter() < deadline:
+        names = sorted(
+            n for n in os.listdir(flight_dir)
+            if n.startswith("csvplus_flight.") and n.endswith(".json")
+        )
+        if not names:
+            time.sleep(0.01)
+    out = []
+    for name in names:
+        with open(os.path.join(flight_dir, name)) as f:
+            out.append(json.load(f))
+    return out
+
+
+def _fired_sites(dumps):
+    return {
+        ev.get("site")
+        for payload in dumps
+        for ev in payload["events"]
+        if ev.get("kind") == "fault:fired"
+    }
+
+
+def test_dispatcher_crash_fails_pending_and_future_fast(
+    served, tmp_path, monkeypatch
+):
     idx, ids = served
+    flight_dir = str(tmp_path / "flight")
+    os.makedirs(flight_dir)
+    monkeypatch.setenv("CSVPLUS_FLIGHT_DIR", flight_dir)
     srv = LookupServer(idx, tick_us=20000)  # hold the batch open: all
     srv.start()  # submits below coalesce into the doomed first dispatch
     try:
@@ -194,6 +232,19 @@ def test_dispatcher_crash_fails_pending_and_future_fast(served):
         # post-mortem submits fail fast and typed at admission
         with pytest.raises(ServerCrashed):
             srv.submit(f"c{int(ids[0])}")
+        # the crash left a flight dump that parses and names the firing
+        # fault site in its event timeline
+        dumps = _flight_dumps(flight_dir)
+        assert dumps, "dispatcher crash must dump the flight ring"
+        assert any(
+            d["reason"] == "serve:dispatcher-crash" for d in dumps
+        )
+        assert all(d["schema_version"] == 1 for d in dumps)
+        assert "serve:dispatch" in _fired_sites(dumps)
+        crash = next(
+            d for d in dumps if d["reason"] == "serve:dispatcher-crash"
+        )
+        assert crash["error"]["type"] == "InjectedFatalError"
     finally:
         srv.stop()
 
@@ -645,18 +696,22 @@ def test_wal_crash_restart_upsert_mode(tmp_path):
 # -- views: refresh crash window (ISSUE 12) ---------------------------------
 
 
-def test_view_refresh_crash_leaves_snapshot_served():
+def test_view_refresh_crash_leaves_snapshot_served(tmp_path, monkeypatch):
     """A ``views:refresh`` death inside the dispatch cycle: the
     dispatcher survives (the failure is counted per-view, never
     propagated), readers keep the prior epoch-pinned snapshot, the
     events stay queued, and the next cycle's disarmed retry converges
-    the view to from-scratch parity."""
+    the view to from-scratch parity.  The crash window leaves a flight
+    dump naming the firing fault site."""
     from csvplus_tpu import plan as P
     from csvplus_tpu.index import create_index
     from csvplus_tpu.row import Row
     from csvplus_tpu.source import take_rows
     from csvplus_tpu.storage import MutableIndex
 
+    flight_dir = str(tmp_path / "flight")
+    os.makedirs(flight_dir)
+    monkeypatch.setenv("CSVPLUS_FLIGHT_DIR", flight_dir)
     cust = create_index(
         take_rows([Row({"cust_id": f"c{i:03d}", "name": f"n{i:03d}"})
                    for i in range(16)]),
@@ -707,3 +762,15 @@ def test_view_refresh_crash_leaves_snapshot_served():
         assert view.read("o0007") == []
         assert len(view.read("o9001")) == 1
         assert plan.snapshot()["fired"]["views:refresh"] == 1
+        # the crashed refresh left a flight dump (dispatcher still
+        # alive, so this is the views-tier failure path specifically)
+        dumps = _flight_dumps(flight_dir)
+        assert dumps, "views:refresh crash must dump the flight ring"
+        assert any(
+            d["reason"].startswith("views:refresh") for d in dumps
+        )
+        assert "views:refresh" in _fired_sites(dumps)
+        vd = next(
+            d for d in dumps if d["reason"].startswith("views:refresh")
+        )
+        assert vd["error"]["type"] == "InjectedFatalError"
