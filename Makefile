@@ -1,16 +1,16 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal bench-view trace-smoke obs-smoke chaos check dryrun example coldcheck lint analyze asan
+.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal bench-view trace-smoke obs-smoke skew-smoke chaos check dryrun example coldcheck lint analyze asan
 
 test:
 	python -m pytest tests/ -x -q
 
 # The standing local gate: unit suite, static analysis, chaos
 # differential, mutable-index storage bench, materialized-view bench,
-# telemetry-plane smoke — the set a change must keep green before
-# review.
-check: test lint chaos bench-delta bench-wal bench-view obs-smoke
+# telemetry-plane smoke, skew-aware-join smoke — the set a change must
+# keep green before review.
+check: test lint chaos bench-delta bench-wal bench-view obs-smoke skew-smoke
 
 # Static analysis gate (docs/ANALYSIS.md).  The repo AST lint (ctypes
 # boundary + jit retrace rules) always runs; ruff and mypy run when
@@ -72,6 +72,13 @@ bench-micro:
 # bench_mesh_floor.json.  The checked-in record artifact
 # (NORTHSTAR_MESH_r06.json) is only (re)written by record-tier runs:
 #   CSVPLUS_BENCH_MESH_ROWS=100000000 make bench-mesh
+# A second SKEW tier then reruns the pipeline over a Zipf(s=1.1)
+# orders stream, skew-aware vs CSVPLUS_JOIN_SKEW=0 in the same child,
+# gated by warm_join_rows_per_sec_zipf with the same half-floor rule
+# and bitwise parity enforced in-run; its checked-in record
+# (NORTHSTAR_MESH_r07.json) is only (re)written when
+# CSVPLUS_BENCH_MESH_OUT_ZIPF is set.  CSVPLUS_BENCH_MESH_SKEW=0
+# skips the tier.
 bench-mesh:
 	python bench.py --bench-mesh
 
@@ -153,6 +160,17 @@ trace-smoke:
 # override).  One JSON line; exits nonzero on any gate failure.
 obs-smoke:
 	JAX_PLATFORMS=cpu python bench.py --obs-smoke
+
+# Skew-aware partitioned-join smoke (ISSUE 15): a sharded Zipf(s=1.3)
+# join on the hermetic 8-device mesh must be BITWISE equal (positional
+# per-column checksums) to the CSVPLUS_JOIN_SKEW=0 run over the same
+# data, the broadcast tier must engage (hot keys detected, rows
+# broadcast, counters in the process-global registry), and repeated
+# warm skew-aware joins must lower nothing (RecompileWatch).  Seconds
+# long; one JSON line; exits nonzero on any gate failure.  The perf
+# floor for the skew path lives in the bench-mesh skew tier.
+skew-smoke:
+	python bench.py --skew-smoke
 
 # Fault-injection differential gate (docs/RESILIENCE.md): seeded fault
 # schedules against serve load, K-worker streamed ingest, and the

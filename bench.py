@@ -1052,6 +1052,99 @@ def zipf_probe_values(ids, n_probes: int, *, s: float = 1.1, seed: int = 0):
     return rng.choice(np.asarray(ids), size=n_probes, p=weights)
 
 
+def zipf_fact_table(
+    n_orders: int,
+    n_customers: int,
+    *,
+    s: float = 1.1,
+    seed: int = 20160914,
+    data_dir: "str | None" = None,
+    n_products: int = 1000,
+):
+    """Zipf(s)-skewed orders fact table + matching customers dimension
+    (ISSUE 15) — :func:`zipf_probe_values` extended from probe streams
+    to a full on-disk fact table.
+
+    The fact table's ``cust_id`` foreign keys are Zipf(s) draws over a
+    PERMUTED rank->customer mapping, so the heavy customers scatter
+    across the id space instead of clustering inside one range shard's
+    key slice (a consecutive hot block would make the skew trivially
+    range-local and understate the repartition hot-spot the skew tier
+    exists to fix).  Same (n_orders, n_customers, s, seed) -> same
+    bytes; files are cached in NORTHSTAR_DIR and written atomically
+    (.tmp + rename) so an interrupted generation can't leave a short
+    file for the next run to ingest.
+
+    Returns ``(orders_path, customers_path)``; products.csv rides along
+    in the same dir (shared with the uniform northstar tiers).
+    """
+    import numpy as np
+
+    ddir = data_dir or os.environ.get("NORTHSTAR_DIR", "/tmp/northstar_data")
+    os.makedirs(ddir, exist_ok=True)
+    tag = f"{n_orders}_{n_customers}_s{s}"
+    opath = os.path.join(ddir, f"orders_zipf_{tag}.csv")
+    cpath = os.path.join(ddir, f"customers_z{n_customers}.csv")
+    ppath = os.path.join(ddir, "products.csv")
+    chunk = 2_000_000
+    if not os.path.exists(cpath):
+        tmp = cpath + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("id,name\n")
+            for base in range(0, n_customers, chunk):
+                n = min(chunk, n_customers - base)
+                ids = np.arange(base, base + n)
+                lines = np.char.add(
+                    np.char.add("c", ids.astype(np.str_)),
+                    np.char.add(",name", (ids % 9973).astype(np.str_)),
+                )
+                f.write("\n".join(lines.tolist()))
+                f.write("\n")
+        os.replace(tmp, cpath)
+    if not os.path.exists(ppath):
+        tmp = ppath + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("prod_id,product,price\n")
+            for i in range(n_products):
+                f.write(f"p{i},prod{i},{(i % 9900) / 100 + 0.99:.2f}\n")
+        os.replace(tmp, ppath)
+    if not os.path.exists(opath):
+        rng = np.random.default_rng(seed)
+        cust = zipf_probe_values(
+            rng.permutation(n_customers), n_orders, s=s, seed=seed
+        )
+        tmp = opath + ".tmp"
+        t0 = time.perf_counter()
+        with open(tmp, "w") as f:
+            f.write("order_id,cust_id,prod_id,qty\n")
+            for base in range(0, n_orders, chunk):
+                n = min(chunk, n_orders - base)
+                oid = np.arange(base, base + n)
+                prod = rng.integers(0, n_products, n)
+                qty = rng.integers(1, 101, n)
+                lines = np.char.add(
+                    np.char.add(
+                        np.char.add("o", oid.astype(np.str_)),
+                        np.char.add(
+                            ",c", cust[base : base + n].astype(np.str_)
+                        ),
+                    ),
+                    np.char.add(
+                        np.char.add(",p", prod.astype(np.str_)),
+                        np.char.add(",", qty.astype(np.str_)),
+                    ),
+                )
+                f.write("\n".join(lines.tolist()))
+                f.write("\n")
+                print(
+                    f"  gen zipf {base + n:,}/{n_orders:,} rows"
+                    f" ({time.perf_counter() - t0:,.0f}s)",
+                    file=sys.stderr,
+                )
+        os.replace(tmp, opath)
+    return opath, cpath
+
+
 def _micro_lookup() -> int:
     """The `make bench-micro` smoke tier: CPU-only, seconds, hermetic.
 
@@ -1288,7 +1381,10 @@ def _obs_smoke() -> int:
     1. a served pass with Zipf-skewed probes must surface the planted
        heavy hitter in the Prometheus scrape's ``csvplus_skew_topk``
        series — scraped over REAL HTTP from the plane's endpoint, not
-       read from the registry in-process;
+       read from the registry in-process — and (ISSUE 15) a planted
+       BUILD-side hitter (5% duplicate-key rows in the index table)
+       must surface in the same scrape with ``side="build"``, fed by
+       the join-time build sample the partitioned planner offers;
     2. the scrape must carry the serve / index / tail / flight /
        process metric families (the always-on surface an operator
        would dashboard);
@@ -1321,12 +1417,26 @@ def _obs_smoke() -> int:
     n_requests = 64
     max_pct = float(os.environ.get("CSVPLUS_OBS_SMOKE_MAX_PCT", 2.0))
     ids = np.arange(n, dtype=np.int64) * 7 % (n * 3)
-    keys = np.char.add("c", ids.astype(np.str_))
+    keys = np.char.add("c", ids.astype(np.str_)).tolist()
+    vvals = np.arange(n).astype(np.str_).tolist()
+    # planted BUILD-side heavy hitter (ISSUE 15): 5% duplicate-key rows
+    # appended (not overwritten — every probed key stays present), so
+    # the join-time build-side sample must surface "hotcust" under
+    # side="build" in the same scrape the probe hitter rides
+    n_hot_rows = n // 20
+    keys += ["hotcust"] * n_hot_rows
+    vvals += ["0"] * n_hot_rows
     t = DeviceTable.from_pylists(
-        {"cust_id": keys.tolist(), "v": np.arange(n).astype(np.str_).tolist()},
+        {"cust_id": keys, "v": vvals},
         device="cpu",
     )
     idx = cp.take(t).index_on("cust_id").sync()
+    # reset BEFORE the index's first lookup: offer_build_sample is
+    # once-per-index, so a reset after it fired would wipe the sketch
+    # for the rest of the process
+    from csvplus_tpu.obs.joinskew import joinskew
+
+    joinskew.reset()
     draws = zipf_probe_values(ids, n_probes)
     probes = [f"c{int(v)}" for v in draws]
     # the planted heavy hitter: the empirically most frequent key of
@@ -1358,6 +1468,17 @@ def _obs_smoke() -> int:
                 f"obs-smoke FAILED: warm recompiles {recompiles}\n"
             )
             return 1
+
+        # join-time build-side offer (ISSUE 15): one small device join
+        # against the same index makes the planner sample its build
+        # keys into the process-global joinskew sketch, which the
+        # plane's scrape merges under side="build"
+        from csvplus_tpu.columnar.ingest import source_from_table
+
+        probe_t = DeviceTable.from_pylists(
+            {"cust_id": probes[:512]}, device="cpu"
+        )
+        source_from_table(probe_t).join(idx, "cust_id").to_rows()
 
         # the scrape, over real HTTP
         port = srv.plane.serve_http()
@@ -1393,6 +1514,17 @@ def _obs_smoke() -> int:
             sys.stderr.write(
                 f"obs-smoke FAILED: heavy hitter {hitter} not in "
                 f"csvplus_skew_topk ({len(topk_lines)} top-K lines)\n"
+            )
+            return 1
+        build_lines = [
+            ln for ln in topk_lines
+            if 'key="hotcust"' in ln and 'side="build"' in ln
+        ]
+        if not build_lines:
+            sys.stderr.write(
+                "obs-smoke FAILED: planted build-side hitter 'hotcust'"
+                f" not in csvplus_skew_topk ({len(topk_lines)} top-K"
+                " lines)\n"
             )
             return 1
 
@@ -1437,6 +1569,7 @@ def _obs_smoke() -> int:
         "max_pct": max_pct,
         "heavy_hitter": hitter,
         "hitter_in_topk": True,
+        "build_hitter_in_topk": True,
         "topk_series": len(topk_lines),
         "cycles": cycles,
         "probes_sketched": observed,
@@ -1458,9 +1591,160 @@ def _obs_smoke() -> int:
         return 1
     sys.stderr.write(
         f"obs-smoke ok: hitter {hitter} in top-K ({len(topk_lines)}"
-        f" series), {cycles} cycles / {observed} probes sketched,"
+        f" series), build hitter 'hotcust' in side=\"build\" top-K,"
+        f" {cycles} cycles / {observed} probes sketched,"
         f" always-on overhead {overhead_pct:.4f}% (budget {max_pct}%),"
         f" zero warm recompiles\n"
+    )
+    return 0
+
+
+def _skew_smoke() -> int:
+    """The `make skew-smoke` tier: the skew-aware partitioned join's
+    correctness contract in seconds, hermetic 8-device CPU mesh
+    (ISSUE 15; the perf floor lives in the `make bench-mesh` skew
+    tier — this gate is the cheap every-`make check` correctness leg).
+
+    Gates, ONE JSON line on stdout, nonzero exit on any failure:
+
+    1. bitwise parity: positional per-column checksums of a sharded
+       Zipf(s=1.3) join are identical to the ``CSVPLUS_JOIN_SKEW=0``
+       run's over the same data;
+    2. the broadcast tier ENGAGED: heavy keys detected, rows routed
+       through the broadcast tier, and the routing counters landed in
+       the process-global registry (the telemetry-plane families);
+    3. zero warm recompiles across repeated skew-aware joins
+       (``RecompileWatch.assert_zero``).
+    """
+    if os.environ.get("CSVPLUS_SKEW_SMOKE_HERMETIC") != "1":
+        env = dict(os.environ)
+        env["CSVPLUS_SKEW_SMOKE_HERMETIC"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+    import numpy as np
+
+    import csvplus_tpu as cp
+    import csvplus_tpu.ops.join as J
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+    from csvplus_tpu.obs.joinskew import joinskew
+    from csvplus_tpu.obs.memory import host_header
+    from csvplus_tpu.obs.recompile import RecompileWatch
+    from csvplus_tpu.parallel.mesh import make_mesh
+    from csvplus_tpu.utils.checksum import checksum_device_table
+
+    n_rows = int(os.environ.get("CSVPLUS_SKEW_SMOKE_ROWS", 200_000))
+    n_keys = int(os.environ.get("CSVPLUS_SKEW_SMOKE_KEYS", 20_000))
+    # engage the partition tier at smoke scale (dedicated process: the
+    # class-level override can't leak anywhere)
+    J.DeviceIndex.PARTITION_MIN_KEYS = 1
+
+    t0_all = time.perf_counter()
+    rng = np.random.default_rng(20160914)
+    # permute rank->key so the hot keys don't cluster in one shard's range
+    cust = zipf_probe_values(
+        rng.permutation(n_keys), n_rows, s=1.3, seed=20260805
+    )
+    mesh = make_mesh(8)
+    stream = DeviceTable.from_pylists(
+        {
+            "k": [f"c{int(v)}" for v in cust],
+            "qty": [str(int(v) % 9) for v in cust],
+        },
+        device="cpu",
+    ).with_sharding(mesh)
+    build = DeviceTable.from_pylists(
+        {
+            "k": [f"c{i}" for i in range(n_keys)],
+            "name": [f"n{i % 97}" for i in range(n_keys)],
+        },
+        device="cpu",
+    )
+    idx = cp.take(build).index_on("k").sync()
+    joinskew.reset()
+
+    def sums():
+        out = source_from_table(stream).join(idx, "k").to_device_table()
+        out = out.sync()
+        assert out.nrows == n_rows, out.nrows
+        return checksum_device_table(
+            out, sorted(out.columns), positional=True
+        )
+
+    os.environ["CSVPLUS_JOIN_SKEW"] = "0"
+    naive_sums = sums()
+    os.environ["CSVPLUS_JOIN_SKEW"] = "1"
+    skew_sums = sums()  # cold skew pass compiles the hot-tier variant
+    if skew_sums != naive_sums:
+        sys.stderr.write(
+            f"skew-smoke FAILED: checksum parity broke:"
+            f" {skew_sums} != {naive_sums}\n"
+        )
+        return 1
+    with RecompileWatch() as watch:
+        for _ in range(2):
+            if sums() != naive_sums:
+                sys.stderr.write(
+                    "skew-smoke FAILED: warm skew pass diverged\n"
+                )
+                return 1
+        recompiles = watch.delta()
+    if recompiles:
+        sys.stderr.write(
+            f"skew-smoke FAILED: warm recompiles {recompiles}\n"
+        )
+        return 1
+
+    counters = joinskew.counters_snapshot().get("k")
+    if (
+        counters is None
+        or counters["hot_keys_detected"] < 1
+        or counters["rows_broadcast"] <= 0
+    ):
+        sys.stderr.write(
+            f"skew-smoke FAILED: broadcast tier never engaged on a"
+            f" Zipf(1.3) stream (counters: {counters})\n"
+        )
+        return 1
+    # per-join routing must cover the stream exactly (3 engaged joins:
+    # cold naive ran with the tier disabled and records nothing)
+    if (
+        counters["rows_broadcast"] + counters["rows_repartitioned"]
+        != counters["joins"] * n_rows
+    ):
+        sys.stderr.write(
+            f"skew-smoke FAILED: routing split does not cover the"
+            f" stream (counters: {counters})\n"
+        )
+        return 1
+    record = {
+        "metric": "skew_smoke",
+        "value": round(counters["rows_broadcast"] / counters["joins"], 1),
+        "unit": "rows_broadcast_per_join",
+        "rows": n_rows,
+        "n_keys": n_keys,
+        "zipf_s": 1.3,
+        "hot_keys_detected": counters["hot_keys_detected"],
+        "rows_repartitioned_per_join": round(
+            counters["rows_repartitioned"] / counters["joins"], 1
+        ),
+        "parity_bitwise": True,
+        "warm_recompiles": 0,
+        "wall_sec": round(time.perf_counter() - t0_all, 1),
+        **host_header(),
+    }
+    print(json.dumps(record), flush=True)
+    sys.stderr.write(
+        f"skew-smoke ok: {counters['hot_keys_detected']} hot keys,"
+        f" {record['value']:,.0f}/{n_rows} rows broadcast per join,"
+        f" bitwise parity vs CSVPLUS_JOIN_SKEW=0, zero warm recompiles"
+        f" ({record['wall_sec']}s)\n"
     )
     return 0
 
@@ -1598,6 +1882,132 @@ def _bench_mesh() -> int:
         f" (floor {floor:,.0f}) | ingest"
         f" {record.get('ingest_rows_per_sec', 0):,.0f} rows/s | rss"
         f" {record.get('peak_host_rss_mb', 0):,.0f} MB (n={rows})\n"
+    )
+
+    # ---- skew tier (ISSUE 15): the same pipeline over a Zipf(s=1.1)
+    # orders stream, skew-aware vs CSVPLUS_JOIN_SKEW=0 in the SAME
+    # child run, gated by the warm_join_rows_per_sec_zipf floor with
+    # the identical half-floor rule.  CSVPLUS_BENCH_MESH_ZIPF_ROWS
+    # sizes it (default = the uniform tier's rows);
+    # CSVPLUS_BENCH_MESH_OUT_ZIPF names the artifact (default none, so
+    # a CI gate run cannot overwrite the checked-in
+    # NORTHSTAR_MESH_r07.json record); CSVPLUS_BENCH_MESH_SKEW=0
+    # skips the tier. ----
+    if os.environ.get("CSVPLUS_BENCH_MESH_SKEW", "1") == "0":
+        sys.stderr.write("bench[mesh] skew tier skipped (env)\n")
+        return 0
+    zrows = int(os.environ.get("CSVPLUS_BENCH_MESH_ZIPF_ROWS", rows))
+    zout = os.environ.get("CSVPLUS_BENCH_MESH_OUT_ZIPF")
+    cmd = [
+        sys.executable,
+        os.path.join(repo, "examples", "northstar_mesh.py"),
+        str(zrows),
+        "--skew",
+    ]
+    try:
+        child = subprocess.run(
+            cmd,
+            capture_output=True,
+            text=True,
+            timeout=max(_remaining() - 20, 120),
+        )
+    except subprocess.TimeoutExpired as e:
+        tail = (e.stderr.decode() if isinstance(e.stderr, bytes) else e.stderr) or ""
+        sys.stderr.write(
+            f"bench[mesh:zipf] FAILED: run timed out; stderr tail:"
+            f" {tail[-600:]}\n"
+        )
+        return 1
+    for line in (child.stderr or "").splitlines():
+        sys.stderr.write(f"bench[mesh:zipf] {line}\n")
+    zrec = None
+    for line in reversed((child.stdout or "").splitlines()):
+        try:
+            rec = json.loads(line)
+            if (
+                isinstance(rec, dict)
+                and rec.get("metric") == "northstar_mesh_threeway_join_zipf"
+            ):
+                zrec = rec
+                break
+        except ValueError:
+            continue
+    if zrec is None or child.returncode != 0:
+        sys.stderr.write(
+            f"bench[mesh:zipf] FAILED: rc={child.returncode}, no record"
+            f" line; stderr tail: {(child.stderr or '')[-600:]}\n"
+        )
+        return 1
+    try:
+        zrec["commit"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, cwd=repo, timeout=10,
+        ).stdout.strip() or None
+    except Exception:
+        pass
+    if zout:
+        with open(zout, "w") as f:
+            json.dump(zrec, f, indent=1)
+            f.write("\n")
+        sys.stderr.write(f"bench[mesh:zipf]: artifact written to {zout}\n")
+
+    floor_z = 0.0
+    floor_z_rows = None
+    try:
+        with open(os.path.join(repo, "bench_mesh_floor.json")) as f:
+            fl = json.load(f)
+            floor_z = float(fl.get("warm_join_rows_per_sec_zipf", 0.0))
+            floor_z_rows = fl.get("zipf_rows")
+    except (OSError, ValueError):
+        pass
+    warm_z = float(zrec.get("join_rows_per_sec_warm_zipf", 0.0))
+    speedup = float(zrec.get("skew_speedup", 0.0))
+    print(
+        json.dumps(
+            {
+                "metric": "northstar_mesh_threeway_join_zipf",
+                "rows": zrec.get("rows"),
+                "value": warm_z,
+                "unit": "rows/s",
+                "join_rows_per_sec_warm_naive": zrec.get(
+                    "join_rows_per_sec_warm_naive"
+                ),
+                "skew_speedup": speedup,
+                "hot_keys_per_join": (
+                    round(
+                        zrec["skew_counters"]["hot_keys_detected"]
+                        / max(zrec["skew_counters"]["joins"], 1),
+                        1,
+                    )
+                    if zrec.get("skew_counters")
+                    else None
+                ),
+                "parity_bitwise": zrec.get("parity_bitwise"),
+                "backend": zrec.get("backend"),
+                "floor": floor_z,
+            }
+        ),
+        flush=True,
+    )
+    if floor_z and warm_z < floor_z / 2:
+        sys.stderr.write(
+            f"bench[mesh:zipf] REGRESSION: warm skew-aware join"
+            f" {warm_z:,.0f} rows/s is under half the floor"
+            f" ({floor_z:,.0f} rows/s at {floor_z_rows or '?'} rows)\n"
+        )
+        return 1
+    if speedup < 2.0:
+        sys.stderr.write(
+            f"bench[mesh:zipf] WARNING: skew speedup {speedup:,.2f}x is"
+            f" under the 2x record bar at this tier (record runs gate on"
+            f" the r07 artifact; the hard floor here is"
+            f" warm_join_rows_per_sec_zipf)\n"
+        )
+    sys.stderr.write(
+        f"bench[mesh:zipf] ok: warm skew-aware join {warm_z:,.0f} rows/s"
+        f" (naive {zrec.get('join_rows_per_sec_warm_naive', 0):,.0f},"
+        f" speedup {speedup:,.2f}x, floor {floor_z:,.0f}) | bitwise"
+        f" parity | (n={zrows})\n"
     )
     return 0
 
@@ -1914,4 +2324,9 @@ if __name__ == "__main__":
         # warm recompiles — hermetic CPU
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sys.exit(_obs_smoke())
+    if "--skew-smoke" in sys.argv:
+        # skew-aware join smoke: bitwise parity vs CSVPLUS_JOIN_SKEW=0,
+        # broadcast tier engaged, zero warm recompiles — the function
+        # re-execs itself into the hermetic 8-device CPU env
+        sys.exit(_skew_smoke())
     main()

@@ -427,6 +427,14 @@ _WORKER_ENTRY_NAMES = (
     "on_view_read",
     "ensure_pruner",
     "prune_directory",
+    # csvplus_tpu/obs/joinskew + ops/join skew entry points (ISSUE 15):
+    # the partitioned probe's routing-evidence mutators (hit from every
+    # pipeline/ingest/serve thread that executes a sharded join) and
+    # the index's once-only build-sample offer (first probe or point
+    # lookup wins the race under the aux lock).
+    "on_join",
+    "offer_build",
+    "offer_build_sample",
 )
 
 _EAGER_TRANSFORM_OPS = frozenset(

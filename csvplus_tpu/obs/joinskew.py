@@ -1,0 +1,105 @@
+"""Process-global skew-routing evidence from the partitioned join (ISSUE 15).
+
+The sharded join's planner detects probe-side heavy hitters per
+execution (parallel/pjoin.py ``_detect_hot``) and routes them through
+the replicated broadcast tier while the tail rides the hash-repartition
+exchange.  That routing decision is exactly the evidence an operator
+needs at scrape time: which indexes saw hot keys, how many rows
+bypassed the exchange, and what the build-side key distribution looked
+like when the plan was made.  This module is the registry those
+counters land in — one lock round per join — and ``TelemetryPlane``
+exports it inside the same constant-lock-round metrics cycle as every
+other collector (the ``csvplus_join_*`` counter families plus the
+build side of ``csvplus_skew_*``).
+
+It is process-global rather than plane-local because joins run on
+pipelines that never attach a serving plane; a plane merely *reads*
+this registry when it samples.
+
+Thread model: a monitor.  ``on_join`` / ``offer_build`` are worker
+entry points (the partitioned probe executes on ingest workers, the
+serve dispatcher, and caller threads alike); every registry mutation
+sits under the registry lock, and sketch ingestion goes through the
+sketch's own lock (``SpaceSaving.offer_counts``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Hashable
+
+from .sketch import SpaceSaving
+
+__all__ = ["JoinSkewStats", "joinskew"]
+
+
+class JoinSkewStats:
+    """Per-index-label join routing counters + build-side key sketches."""
+
+    def __init__(self, sketch_k: int = 32):
+        self.sketch_k = int(sketch_k)
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Dict[str, int]] = {}
+        self._build_sketches: Dict[str, SpaceSaving] = {}
+
+    # -- ingest ------------------------------------------------------------
+
+    def on_join(
+        self,
+        label: str,
+        hot_keys: int,
+        rows_broadcast: int,
+        rows_repartitioned: int,
+    ) -> None:
+        """Fold one partitioned-probe execution's routing split into the
+        label's counters — one lock round per join."""
+        with self._lock:
+            c = self._counters.get(label)
+            if c is None:
+                c = self._counters[label] = {
+                    "joins": 0,
+                    "hot_keys_detected": 0,
+                    "rows_broadcast": 0,
+                    "rows_repartitioned": 0,
+                }
+            c["joins"] += 1
+            c["hot_keys_detected"] += int(hot_keys)
+            c["rows_broadcast"] += int(rows_broadcast)
+            c["rows_repartitioned"] += int(rows_repartitioned)
+
+    def build_sketch(self, label: str) -> SpaceSaving:
+        """Get-or-create the label's build-side sketch."""
+        with self._lock:
+            sk = self._build_sketches.get(label)
+            if sk is None:
+                sk = self._build_sketches[label] = SpaceSaving(self.sketch_k)
+            return sk
+
+    def offer_build(
+        self, label: str, keys: Iterable[Hashable], counts: Iterable[int]
+    ) -> None:
+        """A build-side key sample (decoded values + sample counts) into
+        the label's sketch.  Aggregation already happened at sampling
+        time (``np.unique``), so this is one sketch lock round."""
+        self.build_sketch(label).offer_counts(keys, counts)
+
+    # -- export ------------------------------------------------------------
+
+    def counters_snapshot(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {label: dict(c) for label, c in self._counters.items()}
+
+    def build_sketches(self) -> Dict[str, SpaceSaving]:
+        """A point-in-time copy of the label->sketch map (the sketches
+        themselves are shared monitors, safe to snapshot() concurrently)."""
+        with self._lock:
+            return dict(self._build_sketches)
+
+    def reset(self) -> None:
+        """Tests only: drop all counters and sketches."""
+        with self._lock:
+            self._counters.clear()
+            self._build_sketches.clear()
+
+
+joinskew = JoinSkewStats()

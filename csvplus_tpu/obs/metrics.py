@@ -697,6 +697,14 @@ class MetricsPump:
                 self._file.close()
 
 
+def _joinskew():
+    """The process-global join-skew registry (lazy import: the plane
+    must stay constructible before any join module is loaded)."""
+    from .joinskew import joinskew
+
+    return joinskew
+
+
 # -- the bundle ------------------------------------------------------------
 
 
@@ -747,6 +755,7 @@ class TelemetryPlane:
         self.registry.register_collector(self.tail.samples, "tail")
         self.registry.register_collector(self._sketch_samples, "skew")
         self.registry.register_collector(self._flight_samples, "flight")
+        self.registry.register_collector(self._join_samples, "join")
         # sketches ride every flight dump, so `obs skew <dump>` answers
         # "what was hot when it died" without a scraper
         self.flight.attach("skew", self.skew_snapshot)
@@ -787,6 +796,8 @@ class TelemetryPlane:
         with self._lock:
             probe = dict(self._probe_sketches)
             build = dict(self._build_sketches)
+        for name, sk in _joinskew().build_sketches().items():
+            build.setdefault(name, sk)
         return {
             "probe": {name: sk.snapshot(n) for name, sk in probe.items()},
             "build": {name: sk.snapshot(n) for name, sk in build.items()},
@@ -795,10 +806,15 @@ class TelemetryPlane:
     def _sketch_samples(self) -> List[Sample]:
         out: List[Sample] = []
         with self._lock:
-            sides = (
-                ("probe", list(self._probe_sketches.items())),
-                ("build", list(self._build_sketches.items())),
-            )
+            probe = list(self._probe_sketches.items())
+            build = dict(self._build_sketches)
+        # the partitioned join's build-side samples live in the
+        # process-global registry (joins run on pipelines that never
+        # attach a plane); merge them into the build side, plane-local
+        # sketches winning a label collision
+        for name, sk in _joinskew().build_sketches().items():
+            build.setdefault(name, sk)
+        sides = (("probe", probe), ("build", sorted(build.items())))
         for side, sketches in sides:
             for name, sk in sketches:
                 out.append(
@@ -814,6 +830,30 @@ class TelemetryPlane:
                             count,
                         )
                     )
+        return out
+
+    def _join_samples(self) -> List[Sample]:
+        """The partitioned join's skew-routing split as counter
+        families — how many heavy keys each index's planner detected
+        and how the probe rows divided between the replicated broadcast
+        tier and the hash-repartition exchange.  Reads the process-
+        global registry, so pipeline joins that never touch a server
+        still show up on the scrape."""
+        out: List[Sample] = []
+        for label, c in sorted(_joinskew().counters_snapshot().items()):
+            tags = (("index", label),)
+            out.append(
+                Sample("csvplus_join_hot_keys_detected_total", "counter",
+                       tags, c["hot_keys_detected"])
+            )
+            out.append(
+                Sample("csvplus_join_rows_broadcast_total", "counter",
+                       tags, c["rows_broadcast"])
+            )
+            out.append(
+                Sample("csvplus_join_rows_repartitioned_total", "counter",
+                       tags, c["rows_repartitioned"])
+            )
         return out
 
     def _flight_samples(self) -> List[Sample]:

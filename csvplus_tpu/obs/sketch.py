@@ -18,9 +18,9 @@ skipped on pop, heap rebuilt when it outgrows the live set) keeps
 ``offer`` amortized O(log k) instead of an O(k) scan per miss, so the
 sketch can sit on the serving probe path within the always-on budget.
 
-Thread model: a monitor — ``offer``/``offer_many`` take the instance
-lock; ``offer_many`` is one lock round for a whole coalesced batch
-(the r08 discipline).
+Thread model: a monitor — ``offer``/``offer_many``/``offer_counts``
+take the instance lock; the batch forms are one lock round for a whole
+coalesced batch (the r08 discipline).
 """
 
 from __future__ import annotations
@@ -79,6 +79,22 @@ class SpaceSaving:
         with self._lock:
             for key, n in agg.items():
                 self._offer_locked(key, n)
+
+    def offer_counts(self, keys: Iterable[Hashable], counts: Iterable[int]) -> None:
+        """Count PRE-AGGREGATED ``(key, count)`` pairs in one lock round
+        — the partitioned join planner's entry point: a strided device
+        sample lands as ``np.unique(..., return_counts=True)`` output
+        and feeds straight in.  Numpy scalars are unwrapped to native
+        ints/strs outside the lock so tracked keys (and their exported
+        snapshots) stay JSON-clean and hash-stable across callers."""
+        pairs = [
+            (key.item() if hasattr(key, "item") else key, int(n))
+            for key, n in zip(keys, counts)
+        ]
+        with self._lock:
+            for key, n in pairs:
+                if n > 0:
+                    self._offer_locked(key, n)
 
     def _offer_locked(self, key: Hashable, n: int) -> None:
         self._observed += n

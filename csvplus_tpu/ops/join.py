@@ -354,6 +354,58 @@ class DeviceIndex:
                     host = self._packed_host = np.asarray(self.packed_i32)
         return host
 
+    def _decode_packed(self, packed: np.ndarray) -> list:
+        """Decode packed build keys back to their column values (the
+        rendering the skew surfaces show operators): each key column's
+        code is its bit field, decoded selectively through the column
+        dictionary (string columns) or the typed lane dictionary (int
+        columns) — only the sampled codes, never the full table.
+        Single-column keys unwrap to the scalar, matching
+        ``TelemetryPlane.offer_probes``' convention."""
+        parts = []
+        p64 = packed.astype(np.int64)
+        for name, s, b in zip(self.key_columns, self.shifts, self.bits):
+            codes = ((p64 >> s) & ((1 << b) - 1)).astype(np.int64)
+            parts.append(self.table.columns[name].decode_codes(codes))
+        if len(parts) == 1:
+            return list(parts[0])
+        return [tuple(vs) for vs in zip(*parts)]
+
+    def offer_build_sample(self) -> None:
+        """Once per index: a bounded strided sample of the SORTED packed
+        build keys, decoded and offered into the process-global
+        build-side skew sketch (``obs/joinskew.py``) — the evidence
+        ``csvplus_skew_topk{side="build"}`` exports.  Sorted order makes
+        the strided sample a share estimator: a key owning fraction f of
+        the build rows owns ~f of the stride positions.  The once-guard
+        is double-checked under the aux lock (serving-tier callers race
+        here); after the first call this is one attribute read."""
+        if getattr(self, "_skew_offered", False) or not self.supported:
+            return
+        with self._aux_lock:
+            if getattr(self, "_skew_offered", False):
+                return
+            self._skew_offered = True
+        n = int(self.table.nrows)
+        if n == 0:
+            return
+        step = max(1, -(-n // 4096))
+        if self.packed_i64 is not None:
+            sample = self.packed_i64[::step]
+        else:
+            # EXPLICIT bounded transfer (<= 4096 elements), accounted
+            # like the probe-side hot sample — transfer-guard safe
+            from ..utils.observe import telemetry
+
+            sample = jax.device_get(self.packed_i32[::step])
+            telemetry.count_sync(sample.size)
+        vals, cnts = np.unique(sample, return_counts=True)
+        from ..obs.joinskew import joinskew
+
+        joinskew.offer_build(
+            ",".join(self.key_columns), self._decode_packed(vals), cnts
+        )
+
     def point_bounds(self, values: List[str]) -> Tuple[int, int]:
         """[lower, upper) range for one key-prefix probe — the device form
         of the reference's two binary searches (csvplus.go:881-887).
@@ -424,6 +476,7 @@ class DeviceIndex:
         single ``point_bounds`` calls exactly.
         """
         assert self.supported
+        self.offer_build_sample()
         m = len(probes)
         if m == 0:
             return []
@@ -550,6 +603,7 @@ class DeviceIndex:
         from ..utils.observe import telemetry
 
         assert self.supported
+        self.offer_build_sample()
         k = len(probe_cols)
         with telemetry.stage("join:translate", nrows):
             codes = self._translated(probe_cols, k)
@@ -591,10 +645,11 @@ class DeviceIndex:
 
                 # device-resident end to end: the probe keys, exchange,
                 # hot-key merge and answers never leave the mesh; the
-                # only host syncs are a <=4096-element hot-key sample
-                # and one overflow boolean per capacity retry
+                # only host syncs are a bounded hot-key sample and one
+                # O(1) scalar sync per capacity attempt
                 return partitioned_probe_device(
-                    qk_sh.mesh, qk, self._partitioned_for(qk_sh)
+                    qk_sh.mesh, qk, self._partitioned_for(qk_sh),
+                    label=",".join(self.key_columns),
                 )
 
             if self.direct_cum is not None:
@@ -643,7 +698,8 @@ class DeviceIndex:
             q_hi_m = jnp.where(ok, q_hi, jnp.int32(-1))
             q_lo_m = jnp.where(ok, q_lo, jnp.int32(-1))
             return partitioned_probe_device_wide(
-                qk_sh.mesh, q_hi_m, q_lo_m, self._partitioned_for(qk_sh)
+                qk_sh.mesh, q_hi_m, q_lo_m, self._partitioned_for(qk_sh),
+                label=",".join(self.key_columns),
             )
 
         range_size = 1 << range_shift

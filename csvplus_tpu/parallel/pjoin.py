@@ -13,9 +13,12 @@ inside jit):
   the distinct keys, and every key carries its precomputed global answer
   (first-match row, run length) as an int32 payload — duplicates never
   travel;
-* each shard routes its local probe keys to the owning shard via one
-  ``lax.sort`` by destination + a scatter into an ``(N, C)`` slot buffer
-  + ``lax.all_to_all`` (this is the ICI shuffle);
+* each shard routes its local probe keys to the owning shard via a
+  one-hot running count that ranks rows within their destination group
+  (same slot assignment as a stable sort by dest, ~8x cheaper on CPU,
+  and answers come back in original row order so no un-permute scatter)
+  + a scatter into an ``(N, C)`` slot buffer + ``lax.all_to_all`` (this
+  is the ICI shuffle);
 * the owner answers every received probe with ``(global lower bound,
   match count)`` from a vectorized local binary search, and a reverse
   ``all_to_all`` returns answers through the same slots, so no
@@ -25,21 +28,36 @@ inside jit):
   retries with doubled capacity — the count -> allocate -> fill pattern
   with a geometric backoff instead of a second counting pass.
 
-Skew: PROBE-side heavy hitters are short-circuited before the exchange
-(sampled hot keys answered once via host binary search — a lookup answer
-is constant per key), and residual imbalance is absorbed by the geometric
-capacity retry.  BUILD-side skew is eliminated structurally: because a
-probe answer is just ``(global lower bound, run length)`` — the actual
-match rows are gathered later by global position — shards never need a
-heavy key's duplicate copies at all.  The build side is partitioned over
-its UNIQUE keys, each carrying a precomputed (lower, count) payload, so
-a key that owns 50% of the build rows costs its owner exactly one slot
-(the JSPIM-style salt-and-merge from PAPERS.md is unnecessary under this
-answer representation).
+Skew (ISSUE 15): PROBE-side heavy hitters are detected by a sketch pass
+over a bounded strided sample (``_detect_hot``: SpaceSaving count−err
+lower bound -> a SOUND heavy predicate, threshold
+``CSVPLUS_JOIN_SKEW_THRESHOLD``, default 1/(2·n_shards)) and routed
+through a replicated broadcast tier: the few distinct hot keys are
+answered once, the answers replicated to every shard, and each shard
+resolves its own hot probe rows in place — this IS the JSPIM-style
+salted broadcast, with the existing row placement acting as the salt
+(a hot key's fact rows stay scattered across shards instead of
+collapsing onto the key's range owner) and the positional scatter-back
+at emit (``.at[pos].set``) folding the salt out so row order and
+checksums stay bitwise-identical to the unsalted path.  The tail rides
+the hash-repartition exchange unchanged, with its slot capacity shrunk
+by the sketch's hot-share estimate (``_skew_capacity``); residual
+imbalance is absorbed by the geometric capacity retry, and
+``CSVPLUS_JOIN_SKEW=0`` disables the whole tier (the parity hatch and
+skew-naive bench baseline).  BUILD-side skew is eliminated
+structurally: because a probe answer is just ``(global lower bound,
+run length)`` — the actual match rows are gathered later by global
+position — shards never need a heavy key's duplicate copies at all.
+The build side is partitioned over its UNIQUE keys, each carrying a
+precomputed (lower, count) payload, so a key that owns 50% of the
+build rows costs its owner exactly one slot (a build-side
+salt-and-merge stays unnecessary under this answer representation).
 """
 
 from __future__ import annotations
 
+import math
+import os
 from functools import partial
 from typing import Tuple
 
@@ -54,6 +72,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs.recompile import register_kernel
 from .mesh import row_spec, shard_rows
 
 _SENTINEL = np.int32(np.iinfo(np.int32).max)
@@ -154,32 +173,36 @@ def _probe_shard_kernel(
     route back.  All shapes static.  *axes* is the mesh's full axis-name
     tuple: the exchange spans the whole mesh (ICI within a slice, DCN
     across slices on a 2-D mesh)."""
-    m = qk.shape[0]
     N, C = n_shards, capacity
 
     valid = qk >= 0
     dest = jnp.clip(jnp.searchsorted(splits, qk, side="right") - 1, 0, N - 1)
     # invalid probes (absent keys / hot-key short-circuited) get dest N:
-    # they sort to the end, consume NO exchange slots, and answer (−1, 0)
+    # they consume NO exchange slots and answer (−1, 0)
     dest = jnp.where(valid, dest, N).astype(jnp.int32)
+    routed = valid
 
-    # stable sort by destination, carrying the key and original position
-    pos = jnp.arange(m, dtype=jnp.int32)
-    dest_s, qk_s, pos_s = lax.sort((dest, qk, pos), num_keys=1, is_stable=True)
-    routed = dest_s < N
-
-    # rank of each query within its destination group; dest_s is in
-    # [0, N] by construction (clip for valid, N for invalid)
-    group_start = jnp.searchsorted(
-        dest_s, jnp.arange(N + 1, dtype=jnp.int32), side="left"
+    # rank of each query within its destination group, in original row
+    # order, via a one-hot running count — N is small (mesh size), so
+    # this is one O(m·N) prefix-sum pass.  A stable sort by dest gives
+    # the identical rank assignment (first occurrence -> slot 0) but
+    # costs ~8x more than the cumsum on CPU at mesh-bench scale, and
+    # forces an O(m) un-permute scatter on the way out.
+    safe_dest = jnp.minimum(dest, N - 1)  # N (invalid) is dropped via ok
+    onehot = (dest[:, None] == jnp.arange(N, dtype=jnp.int32)[None, :]).astype(
+        jnp.int32
     )
-    rank = jnp.arange(m, dtype=jnp.int32) - group_start[dest_s]
+    rank = (
+        jnp.take_along_axis(
+            jnp.cumsum(onehot, axis=0), safe_dest[:, None], axis=1
+        )[:, 0]
+        - 1
+    )
     ok = routed & (rank < C)  # overflow -> sentinel, caller retries bigger C
-    safe_dest = jnp.minimum(dest_s, N - 1)  # N (invalid) is dropped via ok
 
     # scatter into (N, C) slot buffer; overflow/invalid drop out of bounds
     buf = jnp.full((N, C), -1, dtype=jnp.int32)
-    buf = buf.at[safe_dest, jnp.where(ok, rank, C)].set(qk_s, mode="drop")
+    buf = buf.at[safe_dest, jnp.where(ok, rank, C)].set(qk, mode="drop")
 
     # ICI shuffle: slot-aligned exchange
     recv = lax.all_to_all(buf, axes, split_axis=0, concat_axis=0, tiled=True)
@@ -202,16 +225,13 @@ def _probe_shard_kernel(
     )
 
     safe_rank = jnp.clip(rank, 0, C - 1)
+    # ranks are per original row order already — no un-permute needed
     got_lo = jnp.where(ok, back_lo[safe_dest, safe_rank], -1)
     # invalid probes answer (lo=-1, ct=0); only routed overflow gets -1
     got_ct = jnp.where(
         routed, jnp.where(ok, back_ct[safe_dest, safe_rank], -1), 0
     )
-
-    # un-permute to original local order
-    out_lo = jnp.zeros(m, jnp.int32).at[pos_s].set(got_lo)
-    out_ct = jnp.zeros(m, jnp.int32).at[pos_s].set(got_ct)
-    return out_lo, out_ct
+    return got_lo, got_ct
 
 
 def _probe_shard_kernel2(
@@ -234,7 +254,6 @@ def _probe_shard_kernel2(
     """
     from ..ops.join import _searchsorted2
 
-    m = qh.shape[0]
     N, C = n_shards, capacity
 
     valid = qh >= 0
@@ -242,23 +261,26 @@ def _probe_shard_kernel2(
         _searchsorted2(splits_hi, splits_lo, qh, ql, side="right") - 1, 0, N - 1
     )
     dest = jnp.where(valid, dest, N).astype(jnp.int32)
+    routed = valid
 
-    pos = jnp.arange(m, dtype=jnp.int32)
-    dest_s, qh_s, ql_s, pos_s = lax.sort(
-        (dest, qh, ql, pos), num_keys=1, is_stable=True
+    # within-destination rank in original row order via one-hot running
+    # count — same slot assignment as the stable sort it replaces, ~8x
+    # cheaper at mesh-bench scale (see _probe_shard_kernel)
+    safe_dest = jnp.minimum(dest, N - 1)
+    onehot = (dest[:, None] == jnp.arange(N, dtype=jnp.int32)[None, :]).astype(
+        jnp.int32
     )
-    routed = dest_s < N
-
-    group_start = jnp.searchsorted(
-        dest_s, jnp.arange(N + 1, dtype=jnp.int32), side="left"
+    rank = (
+        jnp.take_along_axis(
+            jnp.cumsum(onehot, axis=0), safe_dest[:, None], axis=1
+        )[:, 0]
+        - 1
     )
-    rank = jnp.arange(m, dtype=jnp.int32) - group_start[dest_s]
     ok = routed & (rank < C)
-    safe_dest = jnp.minimum(dest_s, N - 1)
 
     slot = jnp.where(ok, rank, C)
-    buf_h = jnp.full((N, C), -1, jnp.int32).at[safe_dest, slot].set(qh_s, mode="drop")
-    buf_l = jnp.full((N, C), -1, jnp.int32).at[safe_dest, slot].set(ql_s, mode="drop")
+    buf_h = jnp.full((N, C), -1, jnp.int32).at[safe_dest, slot].set(qh, mode="drop")
+    buf_l = jnp.full((N, C), -1, jnp.int32).at[safe_dest, slot].set(ql, mode="drop")
 
     recv_h = lax.all_to_all(buf_h, axes, split_axis=0, concat_axis=0, tiled=True)
     recv_l = lax.all_to_all(buf_l, axes, split_axis=0, concat_axis=0, tiled=True)
@@ -283,16 +305,15 @@ def _probe_shard_kernel2(
     )
 
     safe_rank = jnp.clip(rank, 0, C - 1)
+    # ranks are per original row order already — no un-permute needed
     got_lo = jnp.where(ok, back_lo[safe_dest, safe_rank], -1)
     got_ct = jnp.where(
         routed, jnp.where(ok, back_ct[safe_dest, safe_rank], -1), 0
     )
-
-    out_lo = jnp.zeros(m, jnp.int32).at[pos_s].set(got_lo)
-    out_ct = jnp.zeros(m, jnp.int32).at[pos_s].set(got_ct)
-    return out_lo, out_ct
+    return got_lo, got_ct
 
 
+@register_kernel("pjoin.probe_spmd2")
 @partial(jax.jit, static_argnames=("mesh", "n_shards", "capacity"))
 def _probe_spmd2(
     mesh, n_shards, capacity, qh, ql, uniq_hi, uniq_lo, lower, count, splits_hi,
@@ -309,6 +330,7 @@ def _probe_spmd2(
     return f(qh, ql, uniq_hi, uniq_lo, lower, count, splits_hi, splits_lo)
 
 
+@register_kernel("pjoin.probe_spmd")
 @partial(jax.jit, static_argnames=("mesh", "n_shards", "capacity"))
 def _probe_spmd(mesh, n_shards, capacity, qk_sharded, uniq, lower, count, splits):
     axes = tuple(mesh.axis_names)
@@ -416,6 +438,7 @@ def partitioned_probe(
 # element hot-key sample and one boolean overflow scalar per retry.
 
 
+@register_kernel("pjoin.probe_spmd_dev")
 @partial(jax.jit, static_argnames=("mesh", "n_shards", "capacity", "n_hot"))
 def _probe_spmd_dev(
     mesh, n_shards, capacity, n_hot, qk, uniq, lower, count, splits,
@@ -423,7 +446,12 @@ def _probe_spmd_dev(
 ):
     """One executable: hot-key mask -> pad -> all_to_all exchange ->
     un-pad -> hot-key merge -> overflow flag.  *n_hot* = 0 compiles the
-    variant without the hot path (hot operands are 1-element dummies)."""
+    variant without the hot path (hot operands are 1-element dummies)
+    and returns exactly the historical 3-tuple — the uniform-data
+    passthrough contract (same trace, same executable as before the
+    skew tier existed).  *n_hot* > 0 additionally returns the number of
+    probe rows the broadcast tier answered (the routing-split evidence,
+    synced together with the overflow flag — no extra host round)."""
     axes = tuple(mesh.axis_names)
     rows = row_spec(mesh)
     m = qk.shape[0]
@@ -455,9 +483,11 @@ def _probe_spmd_dev(
         h_ct = jnp.take(hot_ct, idxc, axis=0)
         lo = jnp.where(hit, jnp.where(h_ct > 0, h_lo, -1), lo)
         ct = jnp.where(hit, h_ct, ct)
+        return lo, ct, jnp.any(ct < 0), jnp.sum(hit)
     return lo, ct, jnp.any(ct < 0)
 
 
+@register_kernel("pjoin.probe_spmd_dev2")
 @partial(jax.jit, static_argnames=("mesh", "n_shards", "capacity", "n_hot"))
 def _probe_spmd_dev2(
     mesh, n_shards, capacity, n_hot, qh, ql,
@@ -505,6 +535,7 @@ def _probe_spmd_dev2(
         h_ct = jnp.take(hot_ans_ct, idxc, axis=0)
         lo = jnp.where(hit, jnp.where(h_ct > 0, h_lo, -1), lo)
         ct = jnp.where(hit, h_ct, ct)
+        return lo, ct, jnp.any(ct < 0), jnp.sum(hit)
     return lo, ct, jnp.any(ct < 0)
 
 
@@ -530,35 +561,154 @@ def _default_capacity(m: int, n_shards: int) -> int:
     return _pow2(max(64, 2 * ((m_per_shard + n_shards - 1) // n_shards)))
 
 
-def _sample_hot(qk_dev, n_shards: int, wide: bool) -> "np.ndarray | None":
-    """Detect heavy probe keys from a <=4096-element strided device
-    sample — a data-INDEPENDENT host transfer (bounded by the sample
-    cap, not the probe length).  Returns the sorted hot values as
-    int64 (wide) / int32, or None."""
+def skew_enabled() -> bool:
+    """``CSVPLUS_JOIN_SKEW=0`` disables ALL hot-key handling (the
+    parity hatch): no detection, no broadcast tier, default tail
+    capacity — the skew-naive baseline the bench gate compares
+    against.  Read per call so one process can flip it between
+    passes (the bench measures both modes in the same run)."""
+    return os.environ.get("CSVPLUS_JOIN_SKEW", "1") != "0"
+
+
+def skew_threshold(n_shards: int) -> float:
+    """Heavy-hitter share threshold τ: a probe key is worth
+    broadcasting once its estimated share exceeds τ
+    (``CSVPLUS_JOIN_SKEW_THRESHOLD``, default ``1/(2·n_shards)``).
+    Rationale for the default — the broadcast-vs-repartition cutoff:
+    under hash repartition, one key's rows all land on its owner, so a
+    key with share τ adds τ·m rows to one shard on top of the shard's
+    m/n fair share; at τ = 1/(2n) that's a 50% overload, the point
+    where the (N, C) slot buffer must grow a power of two and every
+    shard pays the doubled exchange.  Broadcasting such a key instead
+    costs one replicated answer slot — O(1) — so the cutoff sits where
+    the repartition cost first becomes super-linear."""
+    v = os.environ.get("CSVPLUS_JOIN_SKEW_THRESHOLD")
+    if v:
+        return max(float(v), 1e-6)
+    return 1.0 / (2.0 * max(int(n_shards), 1))
+
+
+def _skew_sample_cap() -> int:
+    """Sample-size cap (``CSVPLUS_JOIN_SKEW_SAMPLE``, default 4096 —
+    the bound the sync-accounting tests pin).  Detection resolves key
+    shares down to ~16/cap, so benches raise it to see deeper into the
+    Zipf tail."""
+    return max(int(os.environ.get("CSVPLUS_JOIN_SKEW_SAMPLE", 4096)), 64)
+
+
+def _detect_hot(qk_dev, n_shards: int, wide: bool):
+    """Sketch-driven heavy-hitter detection over a bounded strided
+    device sample — a data-INDEPENDENT host transfer (bounded by the
+    sample cap, not the probe length).
+
+    The sample's (value, count) aggregate feeds a :class:`SpaceSaving`
+    sketch with ``k = ceil(4/τ)`` tracked keys; a key is classified
+    heavy only when its guaranteed lower bound clears the bar::
+
+        count - err >= max(8, τ·sample/2)
+
+    Soundness: SpaceSaving guarantees any key with sample share > 1/k
+    is tracked, with ``err <= observed/k <= τ·observed/4`` — so every
+    key whose true sample count reaches ``τ·observed`` survives the
+    bar (count ≥ τ·observed, err ≤ τ·observed/4), while any key that
+    clears it provably holds ≥ τ/2 of the sample.  The absolute floor
+    of 8 sample hits guards the small-sample regime where binomial
+    noise dominates.  With fewer distinct sampled keys than *k* the
+    sketch counts are exact (err 0) and the predicate reduces to the
+    plain frequency threshold.
+
+    Returns ``(hot, hot_share)``: sorted distinct hot values as int64
+    (wide) / int32 or None, plus the hot keys' aggregate share of the
+    sample — the planner's capacity hint for the tail exchange."""
+    from ..obs.sketch import SpaceSaving
     from ..utils.observe import telemetry
 
+    if not skew_enabled():
+        return None, 0.0
     m = int(qk_dev[0].shape[0] if wide else qk_dev.shape[0])
     if m < 4 * n_shards:
-        return None
-    step = max(1, -(-m // 4096))  # ceil: the sample stays <= 4096 elements
-    # EXPLICIT device_get: the transfer-guard differential test pins that
-    # the device path performs no *implicit* device->host transfers
-    if wide:
-        hi = jax.device_get(qk_dev[0][::step])
-        lo = jax.device_get(qk_dev[1][::step])
-        telemetry.count_sync(hi.size + lo.size)
-        sample = (hi.astype(np.int64) << 31) | np.where(lo >= 0, lo, 0)
-        sample = sample[hi >= 0]
-    else:
-        sample = jax.device_get(qk_dev[::step])
-        telemetry.count_sync(sample.size)
-        sample = sample[sample >= 0]
-    if not sample.size:
-        return None
-    vals, cnts = np.unique(sample, return_counts=True)
-    thresh = max(8, sample.size // (4 * n_shards))
-    hot = vals[cnts >= thresh]
-    return hot if hot.size else None
+        return None, 0.0
+    tau = skew_threshold(n_shards)
+    with telemetry.stage("join:skew-detect", m) as _d:
+        cap = _skew_sample_cap()
+        step = max(1, -(-m // cap))  # ceil: the sample stays <= cap elements
+        # EXPLICIT device_get: the transfer-guard differential test pins
+        # that the device path performs no *implicit* device->host
+        # transfers
+        if wide:
+            hi = jax.device_get(qk_dev[0][::step])
+            lo = jax.device_get(qk_dev[1][::step])
+            telemetry.count_sync(hi.size + lo.size)
+            sample = (hi.astype(np.int64) << 31) | np.where(lo >= 0, lo, 0)
+            sample = sample[hi >= 0]
+        else:
+            sample = jax.device_get(qk_dev[::step])
+            telemetry.count_sync(sample.size)
+            sample = sample[sample >= 0]
+        _d["threshold"] = round(tau, 6)
+        _d["sample"] = int(sample.size)
+        _d["hot_keys"] = 0
+        if not sample.size:
+            return None, 0.0
+        vals, cnts = np.unique(sample, return_counts=True)
+        sk = SpaceSaving(k=min(max(int(math.ceil(4.0 / tau)), 8), 4096))
+        sk.offer_counts(vals, cnts)
+        bar = max(8.0, tau * sample.size / 2.0)
+        hot_list = [key for key, c, e in sk.topk() if (c - e) >= bar]
+        _d["hot_keys"] = len(hot_list)
+        if not hot_list:
+            return None, 0.0
+        hot = np.sort(np.asarray(hot_list, dtype=np.int64 if wide else np.int32))
+        # hot share from the EXACT sample counts (not the sketch
+        # estimates): the tail-capacity hint must never overshoot
+        hot_share = float(cnts[np.isin(vals, hot)].sum()) / float(sample.size)
+        _d["hot_share"] = round(hot_share, 4)
+        return hot, hot_share
+
+
+def _skew_capacity(m: int, n_shards: int, hot_share: float) -> int:
+    """Sketch-informed tail capacity: the broadcast tier removes
+    ``hot_share`` of the probe rows from the exchange, so the (N, C)
+    slot buffer only needs to cover the tail.  1.5x slack over the
+    uniform per-(src, dest) expectation absorbs residual tail skew
+    (the heaviest un-broadcast key holds < τ of the rows by the
+    detection guarantee); an undershoot costs one geometric retry,
+    never correctness.  Clamped to the skew-naive default so a bad
+    share estimate can only shrink the exchange, and floored like the
+    default."""
+    tail = max(1.0 - hot_share, 0.0)
+    m_per_shard = (m + n_shards - 1) // n_shards
+    want = int(math.ceil(1.5 * tail * m_per_shard / n_shards))
+    return min(_pow2(max(64, want)), _default_capacity(m, n_shards))
+
+
+def _note_skew(
+    label, m: int, hot_keys: int, rows_broadcast: int, capacity: int,
+    threshold: float,
+) -> None:
+    """The routing-split evidence for one skew-engaged probe: a
+    ``join:skew`` row in the span stage table (so ``obs diff`` can
+    attribute the win) plus the process-global counters
+    ``TelemetryPlane`` exports.  ``seconds=0``: this row is an
+    accounting record — detection and hot-answer time are already
+    attributed to ``join:skew-detect`` / ``join:broadcast`` — so the
+    stage table's time shares stay undistorted."""
+    from ..obs.joinskew import joinskew
+    from ..utils.observe import telemetry
+
+    rows_repartitioned = int(m) - int(rows_broadcast)
+    telemetry.add_stage(
+        "join:skew", m, m, 0.0,
+        hot_keys=int(hot_keys),
+        rows_broadcast=int(rows_broadcast),
+        rows_repartitioned=rows_repartitioned,
+        capacity=int(capacity),
+        threshold=round(float(threshold), 6),
+    )
+    joinskew.on_join(
+        label or "packed", int(hot_keys), int(rows_broadcast),
+        rows_repartitioned,
+    )
 
 
 def _hot_answers_device(mesh, hot: np.ndarray, prepared, wide: bool):
@@ -619,7 +769,12 @@ def _hot_answers_device(mesh, hot: np.ndarray, prepared, wide: bool):
 def _retry_probe_device(mesh: Mesh, m: int, capacity: "int | None", launch):
     """Shared retry driver for the device wrappers: geometric capacity
     doubling keyed off ONE overflow boolean per attempt (the only host
-    sync in the loop), results re-committed to the named mesh."""
+    sync in the loop), results re-committed to the named mesh.
+
+    Returns ``((lo, ct), rows_broadcast, capacity)``: when the launch
+    carries the hot tier (4-tuple results) the broadcast row count
+    rides the same device_get as the overflow flag — still one host
+    round per attempt."""
     from ..utils.observe import telemetry
 
     n_shards = mesh.devices.size
@@ -632,14 +787,22 @@ def _retry_probe_device(mesh: Mesh, m: int, capacity: "int | None", launch):
     # (one fused SPMD executable, not separable from outside)
     with telemetry.stage("join:all_to_all", m) as _x:
         while True:
-            lo, ct, overflow = launch(capacity)
-            telemetry.count_sync(1)
-            if not bool(jax.device_get(overflow)):  # one O(1) scalar sync/attempt
+            res = launch(capacity)
+            lo, ct, overflow = res[0], res[1], res[2]
+            if len(res) > 3:
+                ov, hits = jax.device_get((overflow, res[3]))
+                telemetry.count_sync(2)
+                overflowed, rows_broadcast = bool(ov), int(hits)
+            else:
+                telemetry.count_sync(1)
+                # one O(1) scalar sync per attempt
+                overflowed, rows_broadcast = bool(jax.device_get(overflow)), 0
+            if not overflowed:
                 _x["capacity"] = capacity
                 _x["retries"] = retries
                 out = _renamed_rows(mesh, lo), _renamed_rows(mesh, ct)
                 telemetry.barrier(out)
-                return out
+                return out, rows_broadcast, capacity
             if capacity >= max(padded_m, 1):
                 raise RuntimeError(
                     "partitioned probe: capacity overflow at maximum"
@@ -649,23 +812,33 @@ def _retry_probe_device(mesh: Mesh, m: int, capacity: "int | None", launch):
 
 
 def partitioned_probe_device(
-    mesh: Mesh, qk: jax.Array, prepared, capacity: "int | None" = None
+    mesh: Mesh, qk: jax.Array, prepared, capacity: "int | None" = None,
+    label: "str | None" = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Device-resident narrow-key partitioned probe: *qk* (int32, -1 =
     invalid) stays on device end to end; answers come back as device
     arrays ready for the device fan-out expansion and fused gathers.
 
-    Host syncs per call: one <=4096-element hot-key sample + one
-    overflow boolean per capacity retry (VERDICT round-2 weak #3)."""
+    Host syncs per call: one bounded hot-key sample + one O(1) scalar
+    sync per capacity attempt (VERDICT round-2 weak #3).  *label*
+    names the probed index in the skew-routing evidence
+    (``csvplus_join_*`` counters, ``join:skew`` stage row)."""
     n_shards = mesh.devices.size
     uniq, lower, count, splits = prepared
     m = int(qk.shape[0])
 
-    hot = _sample_hot(qk, n_shards, wide=False)
+    hot, hot_share = _detect_hot(qk, n_shards, wide=False)
     if hot is not None:
-        (hot_vals,), hot_lo, hot_ct, n_hot = _hot_answers_device(
-            mesh, hot, prepared, wide=False
-        )
+        from ..utils.observe import telemetry
+
+        with telemetry.stage("join:broadcast", int(hot.size)) as _b:
+            (hot_vals,), hot_lo, hot_ct, n_hot = _hot_answers_device(
+                mesh, hot, prepared, wide=False
+            )
+            _b["n_hot"] = n_hot
+            telemetry.barrier((hot_vals, hot_lo, hot_ct))
+        if capacity is None:
+            capacity = _skew_capacity(m, n_shards, hot_share)
     else:
         z = jnp.zeros(1, jnp.int32)
         hot_vals = hot_lo = hot_ct = z
@@ -677,7 +850,13 @@ def partitioned_probe_device(
             qk, uniq, lower, count, splits, hot_vals, hot_lo, hot_ct,
         )
 
-    return _retry_probe_device(mesh, m, capacity, launch)
+    out, rows_broadcast, cap_used = _retry_probe_device(mesh, m, capacity, launch)
+    if hot is not None:
+        _note_skew(
+            label, m, int(hot.size), rows_broadcast, cap_used,
+            skew_threshold(n_shards),
+        )
+    return out
 
 
 def partitioned_probe_device_wide(
@@ -686,6 +865,7 @@ def partitioned_probe_device_wide(
     q_lo: jax.Array,
     prepared,
     capacity: "int | None" = None,
+    label: "str | None" = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Device-resident wide-key (62-bit dual-lane) partitioned probe.
     Invalid probes carry (-1, -1) lanes."""
@@ -693,11 +873,18 @@ def partitioned_probe_device_wide(
     uh, ul, lower, count, sh, sl = prepared
     m = int(q_hi.shape[0])
 
-    hot = _sample_hot((q_hi, q_lo), n_shards, wide=True)
+    hot, hot_share = _detect_hot((q_hi, q_lo), n_shards, wide=True)
     if hot is not None:
-        (hot_hi, hot_lo_lane), hot_ans_lo, hot_ans_ct, n_hot = _hot_answers_device(
-            mesh, hot, prepared, wide=True
-        )
+        from ..utils.observe import telemetry
+
+        with telemetry.stage("join:broadcast", int(hot.size)) as _b:
+            (hot_hi, hot_lo_lane), hot_ans_lo, hot_ans_ct, n_hot = (
+                _hot_answers_device(mesh, hot, prepared, wide=True)
+            )
+            _b["n_hot"] = n_hot
+            telemetry.barrier((hot_hi, hot_lo_lane, hot_ans_lo, hot_ans_ct))
+        if capacity is None:
+            capacity = _skew_capacity(m, n_shards, hot_share)
     else:
         z = jnp.zeros(1, jnp.int32)
         hot_hi = hot_lo_lane = hot_ans_lo = hot_ans_ct = z
@@ -710,7 +897,13 @@ def partitioned_probe_device_wide(
             hot_hi, hot_lo_lane, hot_ans_lo, hot_ans_ct,
         )
 
-    return _retry_probe_device(mesh, m, capacity, launch)
+    out, rows_broadcast, cap_used = _retry_probe_device(mesh, m, capacity, launch)
+    if hot is not None:
+        _note_skew(
+            label, m, int(hot.size), rows_broadcast, cap_used,
+            skew_threshold(n_shards),
+        )
+    return out
 
 
 @jax.jit

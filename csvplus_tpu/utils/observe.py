@@ -34,6 +34,14 @@ from typing import Dict, Iterator, List
 
 from ..obs.span import tracer
 
+# count-shaped stage extras that SUM when records of one stage name
+# merge (next to the ``_s``-suffix per-worker second tallies); the skew
+# trio lets a multi-join pipeline's ``join:skew`` rows report total
+# routed rows, not the last join's
+_SUMMED_EXTRAS = frozenset(
+    {"chunks", "hot_keys", "rows_broadcast", "rows_repartitioned"}
+)
+
 
 @dataclass
 class StageRecord:
@@ -188,7 +196,9 @@ class Telemetry:
         """Records merged by stage name (first-seen order): seconds and
         row counts summed; ACCUMULABLE extras (keys ending in ``_s`` —
         per-worker second tallies like the staged ingest's ``scan_s`` /
-        ``encode_s`` — and ``chunks``) sum too, all other extras taken
+        ``encode_s`` — plus the count-shaped ``chunks`` and the skew
+        router's ``hot_keys`` / ``rows_broadcast`` /
+        ``rows_repartitioned``) sum too, all other extras taken
         from the last record of the name (configuration-shaped values
         like ``workers`` or ``max_shard_rows`` must not add across
         records).  This is the per-stage table shape the bench artifacts
@@ -212,7 +222,7 @@ class Telemetry:
                 for k, v in r.extra.items():
                     old = got.extra.get(k)
                     if (
-                        (k.endswith("_s") or k == "chunks")
+                        (k.endswith("_s") or k in _SUMMED_EXTRAS)
                         and isinstance(v, (int, float))
                         and isinstance(old, (int, float))
                     ):
