@@ -1,7 +1,7 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal bench-view trace-smoke obs-smoke skew-smoke chaos check dryrun example coldcheck lint analyze asan
+.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal bench-view bench-opt trace-smoke obs-smoke skew-smoke chaos check dryrun example coldcheck lint analyze asan
 
 test:
 	python -m pytest tests/ -x -q
@@ -10,7 +10,7 @@ test:
 # differential, mutable-index storage bench, materialized-view bench,
 # telemetry-plane smoke, skew-aware-join smoke — the set a change must
 # keep green before review.
-check: test lint chaos bench-delta bench-wal bench-view obs-smoke skew-smoke
+check: test lint chaos bench-delta bench-wal bench-view bench-opt obs-smoke skew-smoke
 
 # Static analysis gate (docs/ANALYSIS.md).  The repo AST lint (ctypes
 # boundary + jit retrace rules) always runs; ruff and mypy run when
@@ -140,6 +140,22 @@ bench-wal:
 # CSVPLUS_BENCH_VIEW_OUT is set.
 bench-view:
 	JAX_PLATFORMS=cpu python bench_view.py
+
+# Plan-rewriter bench (docs/ANALYSIS.md, ISSUE 16): the filter+map+
+# join serving chain runs warm through two plan caches over identical
+# data — one admitted with CSVPLUS_OPTIMIZE=0 — so the measured delta
+# is exactly the provenance-proven rewrite (predicate pushdown below
+# the join, projection pushdown dropping dead payload columns at the
+# scan).  Gated in-bench: the rewriter must fire (permute +
+# drop_after_leaf recipe), bitwise positional-checksum parity on both
+# uniform and Zipf(s=1.1) key distributions, zero warm recompiles on
+# the optimized path, and the optimized rate must stay above half
+# bench_opt_floor.json.  Per-stage attribution (obs-diff stage
+# tables) lands in the artifact only when CSVPLUS_BENCH_OPT_OUT is
+# set (record: BENCH_OPT_r16.json).  One JSON line; exits nonzero on
+# any gate failure.
+bench-opt:
+	JAX_PLATFORMS=cpu python bench.py --bench-opt
 
 # Tracing-subsystem smoke (docs/OBSERVABILITY.md): a traced serving
 # pass on the micro lookup shape must produce per-request span trees,

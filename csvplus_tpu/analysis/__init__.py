@@ -1,21 +1,47 @@
 """Static analysis for the device pipeline.
 
-Three layers:
+Five layers:
 
 * :mod:`.verify` + :mod:`.schema` — the plan-IR static verifier
   (presence/cardinality/lane/PLACEMENT domains), run by the executor
   before every lowering (``CSVPLUS_VERIFY=0`` disables);
+* :mod:`.provenance` + :mod:`.cost` — the rewrite-proving domains:
+  per-stage column footprints and shape bits precise enough to PROVE a
+  rewrite bitwise-safe, and advisory cardinality/per-placement-bytes
+  estimates that rank the candidates;
+* :mod:`.rewrite` — the verifier-checked optimizer: applies only
+  provenance-proven rewrites, re-verifies, asserts the equivalence
+  verdict (``CSVPLUS_OPTIMIZE=0`` disables);
 * :mod:`.astlint` — repo-specific AST lint (ctypes boundary, jit
-  retrace/trace-churn, eager hot loops, worker purity), run by
-  ``make lint`` via ``python -m csvplus_tpu.analysis``;
+  retrace/trace-churn, eager hot loops, worker purity, lock order), run
+  by ``make lint`` via ``python -m csvplus_tpu.analysis``;
 * :mod:`.report` — the ``--json`` CI payload (lint + example-chain
-  verifier reports) snapshot-compared by ``make analyze``.
+  analysis) snapshot-compared by ``make analyze``, and the ``explain``
+  CLI's tables.
 
 See docs/ANALYSIS.md for the rule catalogue.
 """
 
 from .astlint import LintFinding, lint_file, lint_paths, lint_source
-from .report import json_payload
+from .cost import CostEstimate, estimate_plan, rank_join_orders
+from .provenance import (
+    ProvenanceDiagnostic,
+    StageFacts,
+    live_columns,
+    plan_facts,
+    prove_swap_before,
+    stage_facts,
+)
+from .report import json_payload, plan_analysis_json
+from .rewrite import (
+    PlanRecipe,
+    RewriteResult,
+    RewriteVerdictMismatch,
+    apply_recipe,
+    leaf_presence_ok,
+    optimize_enabled,
+    optimize_plan,
+)
 from .schema import (
     PLACE_DEVICE,
     PLACE_HOST,
@@ -41,6 +67,7 @@ from .verify import (
 __all__ = [
     "Card",
     "ColInfo",
+    "CostEstimate",
     "Diagnostic",
     "EXECUTOR_MODEL",
     "ExecutorModel",
@@ -50,15 +77,31 @@ __all__ = [
     "PLACE_HOST",
     "PLACE_UNKNOWN",
     "Placement",
+    "PlanRecipe",
     "PlanReport",
     "Presence",
+    "ProvenanceDiagnostic",
+    "RewriteResult",
+    "RewriteVerdictMismatch",
+    "StageFacts",
+    "apply_recipe",
+    "estimate_plan",
     "json_payload",
+    "leaf_presence_ok",
     "lint_file",
     "lint_paths",
     "lint_source",
+    "live_columns",
+    "optimize_enabled",
+    "optimize_plan",
+    "plan_analysis_json",
+    "plan_facts",
     "placement_of_array",
     "placement_of_column",
+    "prove_swap_before",
+    "rank_join_orders",
     "sharded_placement",
+    "stage_facts",
     "verify_before_lower",
     "verify_plan",
 ]

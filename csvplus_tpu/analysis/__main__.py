@@ -8,11 +8,18 @@
     ``make lint`` contract.
 
 ``python -m csvplus_tpu.analysis --json [--snapshot FILE]``
-    Machine-readable payload (lint findings + plan-IR verifier reports
-    over the example chains; schema in docs/ANALYSIS.md).  ``--snapshot``
+    Machine-readable payload (lint findings + per-plan analysis —
+    verifier report, provenance/cost tables, rewrite decision — over the
+    example chains; schema in docs/ANALYSIS.md).  ``--snapshot``
     compares the payload against a committed expected-diagnostics file
     and exits 3 on drift; ``--write-snapshot`` regenerates it.  The
     ``make analyze`` contract.
+
+``python -m csvplus_tpu.analysis explain [name...] [--json]``
+    Render the per-node provenance/cost/placement tables and the
+    rewrite decision for the named example chains (all of them with no
+    names; ``--list`` prints the names) — the same fixed-width-table
+    CLI shape as ``obs diff``.  Unknown names exit 2.
 """
 
 from __future__ import annotations
@@ -21,8 +28,51 @@ import json
 import sys
 
 
+def _explain(args) -> int:
+    as_json = "--json" in args
+    if as_json:
+        args.remove("--json")
+    list_only = "--list" in args
+    if list_only:
+        args.remove("--list")
+
+    from .report import example_plans, explain_text, plan_analysis_json
+
+    plans = example_plans()
+    if list_only:
+        for name in sorted(plans):
+            print(name)
+        return 0
+    names = args or sorted(plans)
+    unknown = [n for n in names if n not in plans]
+    if unknown:
+        print(
+            f"unknown plan(s): {', '.join(unknown)} — known: "
+            f"{', '.join(sorted(plans))}",
+            file=sys.stderr,
+        )
+        return 2
+    payload = {}
+    blocks = []
+    for name in names:
+        p = plans[name]
+        if isinstance(p, str):
+            payload[name] = {"skipped": p}
+            blocks.append(f"explain: {name}\n{p}")
+        else:
+            payload[name] = plan_analysis_json(p)
+            blocks.append(explain_text(name, p))
+    if as_json:
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        print("\n\n".join(blocks))
+    return 0
+
+
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
+    if args and args[0] == "explain":
+        return _explain(args[1:])
     as_json = "--json" in args
     if as_json:
         args.remove("--json")

@@ -1,6 +1,6 @@
 """Repo-specific AST lint: rules generic linters cannot know.
 
-Seven rule classes have bitten this codebase (or its measured history)
+Eight rule classes have bitten this codebase (or its measured history)
 and are mechanically checkable from the AST:
 
 * **CTYPES001** — the native scanner boundary.  The C ABI's ``c_char``
@@ -42,6 +42,17 @@ and are mechanically checkable from the AST:
   argument) — except under a module-level ``threading.Lock``/``RLock``
   ``with`` block (double-checked pool/library init) or into
   ``threading.local()`` storage.
+* **LOCK001** — the lock-ordering boundary (ISSUE 16).  Two code paths
+  nesting the same pair of locks in opposite orders deadlock under
+  contention.  The repo's monitors (serve dispatcher, storage
+  writer/compactor, views refresh, obs plane) follow a constant-lock-
+  rounds discipline — one lock, bounded work, release — so ANY
+  lexically nested acquisition of two recognized locks (module-level
+  ``Lock``/``RLock`` names, ``*lock``/``*cv`` attributes) is flagged
+  unless the ordered pair appears in the single canonical order table
+  ``LOCK001_CANONICAL_ORDER`` (one documented entry: the views refresh
+  pass).  The allowance list stays empty — sanctioned nesting is an
+  ordering fact, not a per-site waiver.
 * **FAULT001** — the silent-swallow boundary (ISSUE 8).  The reference
   error contract says every failure surfaces typed and row-annotated
   (csvplus.go:1229-1238), but a broad ``except``/``except Exception``/
@@ -56,7 +67,7 @@ and are mechanically checkable from the AST:
   ack data that exists only in the page cache — the acked-then-lost
   window the WAL/manifest machinery exists to close.
 
-Each of TRACE001/EAGER001/THREAD001/FAULT001/IO001 carries an explicit
+Each of TRACE001/EAGER001/THREAD001/LOCK001/FAULT001/IO001 carries an explicit
 allowance list below (``*_ALLOWED``) that STARTS EMPTY and must stay
 empty for the current tree; additions need review.
 
@@ -80,7 +91,7 @@ __all__ = ["LintFinding", "lint_source", "lint_file", "lint_paths"]
 
 @dataclass(frozen=True)
 class LintFinding:
-    code: str  # "CTYPES001" | "JIT001" | "TRACE001" | "EAGER001" | "THREAD001" | "FAULT001" | "IO001"
+    code: str  # "CTYPES001" | "JIT001" | "TRACE001" | "EAGER001" | "THREAD001" | "LOCK001" | "FAULT001" | "IO001"
     path: str
     line: int
     message: str
@@ -323,6 +334,26 @@ EAGER001_ALLOWED: frozenset = frozenset()
 THREAD001_ALLOWED: frozenset = frozenset()
 FAULT001_ALLOWED: frozenset = frozenset()
 IO001_ALLOWED: frozenset = frozenset()
+LOCK001_ALLOWED: frozenset = frozenset()
+
+#: LOCK001's canonical lock-order table: the ONLY sanctioned nested
+#: acquisitions, as ``(outer identity, inner identity)`` pairs (see
+#: ``_lock_identity`` for the identity format: ``Owner.attr`` for
+#: attribute locks, ``module_stem.name`` for module-level locks).  The
+#: repo's concurrency discipline is CONSTANT LOCK ROUNDS — take one
+#: lock, do bounded work, release, then take the next (the r08 metrics
+#: cycle, joinskew's registry-then-sketch sequence, the plan cache's
+#: verify-outside-the-lock miss path) — so any lexical nesting of two
+#: recognized locks is a finding until the pair is reviewed, documented
+#: here, and ordered once for the whole repo.  Current entries:
+#:
+#: * ``MaterializedView._lock -> MaterializedView._qlock`` — the
+#:   refresh pass (serialized by ``_lock``) dequeues tier events under
+#:   the O(1) queue guard; every other ``_qlock`` use is a leaf (no
+#:   lock acquired inside it), so the order is total and deadlock-free.
+LOCK001_CANONICAL_ORDER: frozenset = frozenset({
+    ("MaterializedView._lock", "MaterializedView._qlock"),
+})
 
 # modules whose per-row loops sit on the measured hot path (r06)
 _EAGER_HOT_DIRS = ("ops",)
@@ -1064,6 +1095,91 @@ def _io_findings(tree: ast.Module, path: str) -> List[LintFinding]:
     return findings
 
 
+def _lock_identity(
+    expr: ast.expr, module_locks: Set[str], class_name: Optional[str],
+    stem: str
+) -> Optional[str]:
+    """A stable identity for a lock-like ``with`` context expression,
+    or None when the expression is not lock-like.  Recognition matches
+    THREAD001's: a module-level ``Lock``/``RLock`` name, or a name/
+    attribute whose terminal name ends in ``lock`` or ``cv``.
+    Identities are coarse on purpose — ``Owner.attr`` for attribute
+    locks (the enclosing class for ``self``/``cls`` receivers),
+    ``module_stem.name`` for module-level names — so the canonical
+    order table ranks lock *classes*, not instances."""
+    if isinstance(expr, ast.Name):
+        if expr.id in module_locks or expr.id.endswith(("lock", "cv")):
+            return f"{stem}.{expr.id}"
+        return None
+    if isinstance(expr, ast.Attribute) and expr.attr.endswith(("lock", "cv")):
+        root = _root_name(expr)
+        if root in ("self", "cls") and class_name is not None:
+            return f"{class_name}.{expr.attr}"
+        return f"{root or '?'}.{expr.attr}"
+    return None
+
+
+def _lock_findings(tree: ast.Module, path: str) -> List[LintFinding]:
+    """LOCK001: lexically nested acquisition of two recognized locks —
+    a ``with <lock>`` inside another ``with <lock>`` span (including two
+    lock items in ONE ``with``, acquired left to right) — where the
+    ordered ``(outer, inner)`` pair is not in
+    :data:`LOCK001_CANONICAL_ORDER`.  Two code paths nesting the same
+    pair of locks in opposite orders deadlock under contention; the
+    repo-wide rule is one documented order or no nesting at all.  Lock
+    registry covered: every module-level ``Lock``/``RLock`` plus the
+    ``*lock``/``*cv`` attribute convention — the serve dispatcher,
+    storage writer/compactor, views refresh, and obs plane monitors all
+    follow it.  Nested ``def``/``lambda`` bodies do not execute under
+    the enclosing ``with``, so the held-set resets there."""
+    module_locks = _lock_names(tree)
+    stem = Path(path).stem
+    findings: List[LintFinding] = []
+
+    def flag(outer: str, outer_line: int, inner: str, line: int) -> None:
+        func = _enclosing_function(tree, line)
+        if _allow_key(path, func) in LOCK001_ALLOWED:
+            return
+        findings.append(
+            LintFinding(
+                "LOCK001",
+                path,
+                line,
+                f"acquires `{inner}` while holding `{outer}` (taken at "
+                f"line {outer_line}) and the pair is not in the "
+                "canonical lock order table "
+                "(LOCK001_CANONICAL_ORDER) — nested orders must be "
+                "documented once repo-wide or restructured into "
+                "sequential lock rounds",
+            )
+        )
+
+    def visit(node: ast.AST, held, class_name: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                visit(child, [], class_name)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, held, child.name)
+            elif isinstance(child, (ast.With, ast.AsyncWith)):
+                now = list(held)
+                for item in child.items:
+                    ident = _lock_identity(
+                        item.context_expr, module_locks, class_name, stem)
+                    if ident is None:
+                        continue
+                    for outer, outer_line in now:
+                        if (outer, ident) not in LOCK001_CANONICAL_ORDER:
+                            flag(outer, outer_line, ident, child.lineno)
+                    now.append((ident, child.lineno))
+                visit(child, now, class_name)
+            else:
+                visit(child, held, class_name)
+
+    visit(tree, [], None)
+    return findings
+
+
 _BROAD_EXCEPT_NAMES = frozenset({"Exception", "BaseException"})
 
 
@@ -1164,6 +1280,7 @@ def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
         e.visit(tree)
         findings.extend(e.findings)
     findings.extend(_thread_findings(tree, path))
+    findings.extend(_lock_findings(tree, path))
     findings.extend(_fault_findings(tree, path))
     findings.extend(_io_findings(tree, path))
     lines = source.splitlines()

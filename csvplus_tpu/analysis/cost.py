@@ -1,0 +1,352 @@
+"""Cost domain over the plan IR: cardinality + per-placement bytes.
+
+Per chain stage, estimate the OUTPUT cardinality and the bytes the
+stage's output pins per placement class — host, device, and
+*replicated* (a broadcast join build side is materialized once per
+shard, the r06 failure mode: pricing work alone said "fuse everything"
+while mesh RSS went 7.2→11.8GB).  Estimates are seeded from real
+statistics when the process has them and schema defaults otherwise:
+
+* column distinct counts come from dictionary sizes
+  (``StringColumn.dict_size`` — a metadata read, never a device sync);
+* join build-side key distributions come from the SpaceSaving sketches
+  the partitioned join already feeds (``obs/joinskew.py``): the
+  expected per-probe fanout under a probe-follows-build workload is
+  ``n_build × Σ share²`` — the self-join-size estimator — which the
+  sketch's tracked shares bound without holding the key stream;
+* everything else falls back to documented default selectivities.
+
+The domain is advisory: it RANKS candidate plans (Filter ordering, Join
+orderings) for the rewriter and the ``explain`` CLI.  Proofs of safety
+live in :mod:`csvplus_tpu.analysis.provenance`; nothing here may make a
+rewrite legal, only cheap.  Like the verifier, every input is metadata
+the plan already holds — ``estimate_plan`` is O(plan), not O(rows).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from itertools import permutations
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import plan as P
+from ..predicates import All, Any_, Like, Not
+from ..ops.join import device_index_static_info
+from . import provenance as PV
+from .schema import placement_of_column
+
+__all__ = [
+    "CostEstimate",
+    "estimate_plan",
+    "predicate_selectivity",
+    "rank_join_orders",
+]
+
+#: Bytes per row per column: int32 codes / int32 typed lanes.
+BYTES_PER_CELL = 4.0
+#: Distinct-count default when no dictionary metadata exists.
+DEFAULT_DISTINCT = 32
+#: Selectivity floor/defaults.
+MIN_SELECTIVITY = 1e-4
+OPAQUE_SELECTIVITY = 0.33  # unlowerable predicate: assume 1-in-3
+WHILE_SELECTIVITY = 0.5  # TakeWhile/DropWhile prefix split
+EXCEPT_SELECTIVITY = 0.5  # anti-join survival rate
+DEFAULT_ROWS = 1024.0  # leaf with no table metadata (structural plans)
+
+
+@dataclass(frozen=True)
+class CostEstimate:
+    """Estimated output of one chain stage."""
+
+    stage: str
+    rows: float
+    bytes_host: float
+    bytes_device: float
+    bytes_replicated: float
+    selectivity: Optional[float] = None  # narrowing stages only
+    note: str = ""
+
+    def as_dict(self) -> Dict[str, Any]:
+        d = {
+            "stage": self.stage,
+            "rows": round(self.rows, 1),
+            "bytes_host": round(self.bytes_host, 1),
+            "bytes_device": round(self.bytes_device, 1),
+            "bytes_replicated": round(self.bytes_replicated, 1),
+        }
+        if self.selectivity is not None:
+            d["selectivity"] = round(self.selectivity, 6)
+        if self.note:
+            d["note"] = self.note
+        return d
+
+
+def _distinct_of(col) -> int:
+    """Distinct-value estimate from column metadata (no device sync)."""
+    try:
+        n = int(getattr(col, "dict_size"))
+        return max(1, n)
+    except (AttributeError, TypeError, ValueError):
+        return DEFAULT_DISTINCT
+
+
+def predicate_selectivity(pred, distinct: Dict[str, int]) -> float:
+    """Estimated pass fraction of *pred* given per-column distinct
+    counts: a ``Like`` equality keeps ~1/distinct per referenced column;
+    ``All``/``Any``/``Not`` compose under independence."""
+    if isinstance(pred, Like):
+        s = 1.0
+        for col in pred.match:
+            s *= 1.0 / float(distinct.get(col, DEFAULT_DISTINCT))
+        return max(MIN_SELECTIVITY, s)
+    if isinstance(pred, All):
+        s = 1.0
+        for q in pred.preds:
+            s *= predicate_selectivity(q, distinct)
+        return max(MIN_SELECTIVITY, s)
+    if isinstance(pred, Any_):
+        miss = 1.0
+        for q in pred.preds:
+            miss *= 1.0 - predicate_selectivity(q, distinct)
+        return max(MIN_SELECTIVITY, 1.0 - miss)
+    if isinstance(pred, Not):
+        return max(MIN_SELECTIVITY, 1.0 - predicate_selectivity(pred.pred, distinct))
+    return OPAQUE_SELECTIVITY
+
+
+def _sketch_fanout(sketch, n_build: float, d_build: int) -> Tuple[float, str]:
+    """Expected per-probe match count from a build-side SpaceSaving
+    sketch: ``n_build × Σ share²`` over tracked keys, with the untracked
+    tail spread uniformly over the remaining distinct keys.  Falls back
+    to the uniform ``n_build / d_build`` when the sketch is empty."""
+    observed = sketch.observed
+    if observed <= 0:
+        return (n_build / max(1, d_build), "uniform (empty sketch)")
+    shares = [c / observed for _, c, _ in sketch.topk()]
+    sum_sq = sum(s * s for s in shares)
+    tail_share = max(0.0, 1.0 - sum(shares))
+    tail_keys = max(1, d_build - len(shares))
+    sum_sq += (tail_share * tail_share) / tail_keys
+    return (n_build * sum_sq, f"sketch ({len(shares)} tracked keys)")
+
+
+def _placement_bucket(col) -> str:
+    kind = placement_of_column(col).kind
+    if kind in ("device", "sharded"):
+        return "device"
+    if kind == "host":
+        return "host"
+    return "device"  # unknown: price it at the expensive tier
+
+
+def estimate_plan(
+    root: P.PlanNode,
+    sketches: Optional[Dict[str, Any]] = None,
+) -> List[CostEstimate]:
+    """One :class:`CostEstimate` per :func:`~csvplus_tpu.plan.linearize`
+    slot.  *sketches* maps join-key labels (``",".join(key_columns)``,
+    the ``offer_build_sample`` convention) to SpaceSaving sketches; when
+    ``None`` the process-global :data:`~csvplus_tpu.obs.joinskew.joinskew`
+    registry is consulted."""
+    if sketches is None:
+        from ..obs.joinskew import joinskew
+
+        sketches = joinskew.build_sketches()
+    chain = P.linearize(root)
+    facts = [PV.stage_facts(i, n) for i, n in enumerate(chain)]
+    out: List[CostEstimate] = []
+
+    # Rolling state: rows, per-column distinct counts, per-column
+    # placement buckets ("host"/"device").  Schema evolution follows the
+    # provenance facts so the two domains can never disagree on it.
+    leaf = chain[0]
+    table = getattr(leaf, "table", None)
+    distinct: Dict[str, int] = {}
+    bucket: Dict[str, str] = {}
+    if table is not None and getattr(table, "columns", None):
+        rows = float(getattr(table, "nrows", 0))
+        for name, col in table.columns.items():
+            distinct[name] = _distinct_of(col)
+            bucket[name] = _placement_bucket(col)
+    else:
+        rows = DEFAULT_ROWS
+    if isinstance(leaf, P.Lookup):
+        rows = float(max(0, leaf.upper - leaf.lower))
+    replicated = 0.0
+
+    def snapshot(pos: int, sel: Optional[float], note: str) -> CostEstimate:
+        bh = sum(rows * BYTES_PER_CELL for b in bucket.values() if b == "host")
+        bd = sum(rows * BYTES_PER_CELL for b in bucket.values() if b == "device")
+        return CostEstimate(
+            facts[pos].label, rows, bh, bd, replicated, sel, note)
+
+    out.append(snapshot(0, None, "" if table is not None else
+                        "no table metadata: default cardinality"))
+
+    for pos in range(1, len(chain)):
+        node, f = chain[pos], facts[pos]
+        sel: Optional[float] = None
+        note = ""
+        if isinstance(node, P.Filter):
+            sel = predicate_selectivity(node.pred, distinct)
+            rows *= sel
+        elif isinstance(node, (P.TakeWhile, P.DropWhile)):
+            sel = WHILE_SELECTIVITY
+            rows *= sel
+        elif isinstance(node, P.Top):
+            rows = min(rows, float(node.n))
+        elif isinstance(node, P.DropRows):
+            rows = max(0.0, rows - float(node.n))
+        elif isinstance(node, P.Except):
+            sel = EXCEPT_SELECTIVITY
+            rows *= sel
+            note = "default anti-join survival"
+        elif isinstance(node, P.Join):
+            info = device_index_static_info(node.index)
+            dev = getattr(node.index, "device_table", None)
+            n_build = float(getattr(getattr(dev, "table", None), "nrows", 0) or 0)
+            meta = info[3] if info is not None else None
+            d_build = (meta or {}).get("packed_keys") or max(
+                1, int(n_build) or DEFAULT_DISTINCT)
+            label = ",".join(info[1]) if info is not None and info[1] else None
+            sk = sketches.get(label) if label else None
+            if sk is not None:
+                fanout, note = _sketch_fanout(sk, n_build, d_build)
+            else:
+                fanout = n_build / max(1, d_build)
+                note = "uniform build keys (no sketch)"
+            rows *= max(fanout, MIN_SELECTIVITY)
+            # Broadcast-tier build sides are replicated once per shard
+            # (the r06 memory lesson): below the partition threshold the
+            # build table rides every device.
+            pmin = (meta or {}).get("partition_min_keys")
+            if pmin is not None and d_build < pmin and dev is not None:
+                tbl = getattr(dev, "table", None)
+                ncols = len(getattr(tbl, "columns", {}) or {})
+                replicated += n_build * ncols * BYTES_PER_CELL
+                note += "; broadcast-tier build (replicated per shard)"
+            # Index columns joining the schema.
+            if info is not None:
+                kinds, keys = info[0], info[1]
+                place = (meta or {}).get("placement")
+                b = "device" if place is None or place.kind != "host" else "host"
+                for name in kinds:
+                    bucket.setdefault(name, b)
+                    distinct.setdefault(name, DEFAULT_DISTINCT)
+
+        # Schema evolution from provenance facts.
+        if f.keeps_only is not None:
+            for name in list(bucket):
+                if name not in f.keeps_only:
+                    bucket.pop(name)
+                    distinct.pop(name, None)
+        for name in f.removes:
+            bucket.pop(name, None)
+            distinct.pop(name, None)
+        for name in f.writes:
+            bucket.setdefault(name, "device")
+            if f.op == "MapExpr":
+                distinct[name] = 1  # constant write / renamed column
+            else:
+                distinct.setdefault(name, DEFAULT_DISTINCT)
+        out.append(snapshot(pos, sel, note))
+    return out
+
+
+def _stage_multiplier(node: P.PlanNode, est: CostEstimate,
+                      prev_rows: float) -> float:
+    if prev_rows <= 0:
+        return 1.0
+    return est.rows / prev_rows
+
+
+def rank_join_orders(
+    root: P.PlanNode,
+    report=None,
+    sketches: Optional[Dict[str, Any]] = None,
+) -> List[Dict[str, Any]]:
+    """Rank orderings of the longest consecutive ``Join``/``Except`` run
+    in *root* by total intermediate cardinality (the classic Σ-of-
+    intermediates objective, multipliers taken from
+    :func:`estimate_plan`).
+
+    Each candidate is marked ``provable``: reachable from the submitted
+    order purely by provenance-proven swaps — i.e. the relative order of
+    row-EXPANDING stages is preserved (reordering two expansions changes
+    the bitwise row layout) and every NARROWING stage moved earlier
+    proves :func:`~csvplus_tpu.analysis.provenance.prove_swap_before`
+    against each stage it crosses.  The rewriter applies only provable
+    orderings; the rest are advisory output for ``explain``.
+    """
+    chain = P.linearize(root)
+    facts = [PV.stage_facts(i, n) for i, n in enumerate(chain)]
+    ests = estimate_plan(root, sketches=sketches)
+
+    # Longest consecutive run of probe stages.
+    best_run: Tuple[int, int] = (0, 0)
+    i = 1
+    while i < len(chain):
+        if isinstance(chain[i], (P.Join, P.Except)):
+            j = i
+            while j + 1 < len(chain) and isinstance(
+                    chain[j + 1], (P.Join, P.Except)):
+                j += 1
+            if j + 1 - i > best_run[1] - best_run[0]:
+                best_run = (i, j + 1)
+            i = j + 1
+        else:
+            i += 1
+    lo, hi = best_run
+    if hi - lo < 2:
+        return []
+
+    run = list(range(lo, hi))
+    rows_in = ests[lo - 1].rows
+    mult = {p: _stage_multiplier(chain[p], ests[p], ests[p - 1].rows)
+            for p in run}
+
+    def presence_ok(_col: str) -> bool:
+        # Without a verifier report we cannot prove presence; with one,
+        # PRESENT at the run's entry state covers every position inside
+        # the run a narrowing stage can move to.
+        if report is None:
+            return False
+        from .schema import Presence
+
+        state = report.states[lo - 1]
+        info = state.schema.get(_col)
+        return info is not None and info.presence == Presence.PRESENT
+
+    def provable(perm: Sequence[int]) -> bool:
+        expanders = [p for p in perm if facts[p].multiplicity == PV.EXPAND]
+        if expanders != [p for p in run
+                         if facts[p].multiplicity == PV.EXPAND]:
+            return False
+        for idx, p in enumerate(perm):
+            if facts[p].multiplicity != PV.NARROW:
+                continue
+            # Stages it now precedes but originally followed.
+            for q in perm[idx + 1:]:
+                if q < p and PV.prove_swap_before(
+                        "join-order", facts[p], facts[q],
+                        presence_ok) is not None:
+                    return False
+        return True
+
+    perms = (list(permutations(run)) if len(run) <= 4
+             else [tuple(run), tuple(sorted(run, key=lambda p: mult[p]))])
+    ranked = []
+    for perm in perms:
+        total = 0.0
+        r = rows_in
+        for p in perm:
+            r *= mult[p]
+            total += r
+        ranked.append({
+            "order": [facts[p].label for p in perm],
+            "est_intermediate_rows": round(total, 1),
+            "provable": provable(perm),
+            "submitted": list(perm) == run,
+        })
+    ranked.sort(key=lambda d: d["est_intermediate_rows"])
+    return ranked

@@ -1,0 +1,307 @@
+"""The verifier-checked plan rewriter (ISSUE 16, ROADMAP item 1).
+
+``optimize_plan`` applies exactly three rewrite rules, each one only
+when the provenance domain (:mod:`.provenance`) PROVES it bitwise-safe
+against the executor's semantics, and records a typed
+:class:`~.provenance.ProvenanceDiagnostic` naming the blocking stage
+for every refusal:
+
+* **predicate pushdown** — ``Filter``/``Except`` stages bubble toward
+  the leaf across Map/Select/Drop/Join stages
+  (:func:`~.provenance.prove_swap_before` per crossing);
+* **filter reordering** — inside a run of adjacent narrowing stages,
+  most-selective-first by the cost domain's estimates (each adjacent
+  swap individually proven);
+* **projection pushdown** — leaf columns no stage reads or writes and
+  the final schema omits are dropped right after the leaf
+  (:func:`~.provenance.live_columns`); a ``DropCols`` there is a pure
+  dict filter with no error semantics, and the big win is ``Join``'s
+  ``materialize()`` no longer gathering dead columns.
+
+The rewritten plan is re-verified with the existing static verifier and
+the EQUIVALENCE VERDICT is asserted: admission verdict (``ok``) and
+emptiness prediction must match the original report's, else
+:class:`RewriteVerdictMismatch` — a rewrite that changes what the
+verifier can prove is a prover bug, never something to execute.
+
+**Replay.**  The serving plan cache stores shapes, not plans: the same
+structural key admits later submissions over DIFFERENT tables.  A
+rewrite therefore ships as a :class:`PlanRecipe` — a data-only
+description (slot permutation + leaf drop list) replayed onto each
+submitted root by :func:`apply_recipe`.  The structural key pins op
+types, predicate/expr shapes, column names/lanes/placements and the
+cardinality class, but NOT cell presence — so every presence fact a
+proof consumed is recorded as a leaf-level obligation
+(``require_present``) and re-checked against the submitted table by
+:func:`leaf_presence_ok` (O(columns), metadata only) before the recipe
+replays.  Proofs only ever consume presence facts that are *stable*:
+derivable from leaf presence through stages that provably do not touch
+the column, so the replay-time check implies the original proof.
+
+``CSVPLUS_OPTIMIZE=0`` disables the rewriter everywhere (the plan
+cache then admits and executes the submitted plan byte-identically to
+the pre-optimizer behavior).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from .. import plan as P
+from ..errors import CsvPlusError
+from . import provenance as PV
+from .provenance import ProvenanceDiagnostic, StageFacts
+from .schema import Presence
+
+__all__ = [
+    "PlanRecipe",
+    "RewriteResult",
+    "RewriteVerdictMismatch",
+    "optimize_enabled",
+    "optimize_plan",
+    "apply_recipe",
+    "leaf_presence_ok",
+]
+
+
+def optimize_enabled() -> bool:
+    return os.environ.get("CSVPLUS_OPTIMIZE", "1") != "0"
+
+
+class RewriteVerdictMismatch(CsvPlusError):
+    """Re-verifying the rewritten plan produced a different verdict
+    than the original — the rewrite is discarded and this is raised so
+    the prover bug is loud (callers on the serving path fall back to
+    the unrewritten plan and count it)."""
+
+
+@dataclass(frozen=True)
+class PlanRecipe:
+    """A data-only rewrite, replayable onto any root with the same
+    structural cache key.  ``steps`` entries are ``("permute", slots)``
+    (a reordering of the :func:`~csvplus_tpu.plan.linearize` chain) or
+    ``("drop_after_leaf", columns)``.  ``require_present`` are leaf
+    columns whose cells must be PRESENT for the proofs to hold on the
+    submitted table."""
+
+    steps: Tuple[Tuple, ...]
+    require_present: Tuple[str, ...] = ()
+
+    def __bool__(self) -> bool:
+        return bool(self.steps)
+
+
+@dataclass(frozen=True)
+class RewriteResult:
+    """Outcome of :func:`optimize_plan` over one plan."""
+
+    root: P.PlanNode  # rewritten (or the original when nothing applied)
+    report: "object"  # PlanReport of `root`
+    original_report: "object"
+    recipe: Optional[PlanRecipe]
+    applied: Tuple[str, ...] = ()
+    blocked: Tuple[ProvenanceDiagnostic, ...] = ()
+
+
+def apply_recipe(root: P.PlanNode, recipe: PlanRecipe) -> P.PlanNode:
+    """Replay *recipe* onto *root* (same structural shape) and rebuild
+    the chain — O(nodes), no verification, no table access beyond the
+    leaf reference already in hand."""
+    chain: List[P.PlanNode] = list(P.linearize(root))
+    for step in recipe.steps:
+        if step[0] == "permute":
+            chain = [chain[i] for i in step[1]]
+        elif step[0] == "drop_after_leaf":
+            chain.insert(1, P.DropCols(chain[0], tuple(step[1])))
+        else:  # unknown step kind: a recipe from a newer writer — refuse
+            raise ValueError(f"unknown recipe step {step[0]!r}")
+    node = chain[0]
+    for stage in chain[1:]:
+        node = dataclasses.replace(stage, child=node)
+    return node
+
+
+def leaf_presence_ok(root: P.PlanNode, columns: Sequence[str]) -> bool:
+    """Are all *columns* provably PRESENT on *root*'s leaf table?  The
+    replay-time check for :attr:`PlanRecipe.require_present` — cached
+    metadata only (``col_info_for`` never syncs)."""
+    if not columns:
+        return True
+    from .schema import col_info_for
+
+    table = getattr(P.linearize(root)[0], "table", None)
+    cols = getattr(table, "columns", None)
+    if not cols:
+        return False
+    for name in columns:
+        col = cols.get(name)
+        if col is None or col_info_for(col).presence is not Presence.PRESENT:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+
+
+def _stable_presence_fn(
+    facts: Sequence[StageFacts],
+    leaf_present: frozenset,
+    upto: int,
+    consumed: set,
+) -> Callable[[str], bool]:
+    """Presence oracle for the input state of ORIGINAL chain slot
+    *upto*: True only when the column is PRESENT at the leaf and no
+    earlier stage can touch it — the *stable* presence the replay-time
+    leaf check can re-establish.  Columns certified True are recorded
+    into *consumed* (they become recipe obligations)."""
+
+    def ok(col: str) -> bool:
+        if col not in leaf_present:
+            return False
+        for q in range(1, upto):
+            f = facts[q]
+            if f.barrier or f.reads is None:
+                return False
+            if col in f.writes or col in f.removes:
+                return False
+            if f.keeps_only is not None and col not in f.keeps_only:
+                return False
+        consumed.add(col)
+        return True
+
+    return ok
+
+
+def _is_mover(f: StageFacts) -> bool:
+    return f.op in ("Filter", "Except")
+
+
+def optimize_plan(root: P.PlanNode, report=None, *,
+                  sketches=None) -> RewriteResult:
+    """Apply every provenance-proven rewrite to *root*, re-verify, and
+    assert the equivalence verdict.  See the module docstring for the
+    rule set and the replay contract."""
+    from .verify import verify_plan
+
+    if report is None:
+        report = verify_plan(root)
+    chain = P.linearize(root)
+    facts = PV.plan_facts(root)
+    n = len(chain)
+    applied: List[str] = []
+    blocked: List[ProvenanceDiagnostic] = []
+    consumed: set = set()
+    leaf_present = frozenset(
+        name for name, info in report.states[0].schema.items()
+        if info.presence is Presence.PRESENT
+    )
+
+    def try_swap(rule: str, order: List[int], j: int) -> bool:
+        """Prove + perform the swap of order[j] before order[j-1]."""
+        p, q = order[j], order[j - 1]
+        oracle = _stable_presence_fn(facts, leaf_present, q, consumed)
+        diag = PV.prove_swap_before(rule, facts[p], facts[q], oracle)
+        if diag is not None:
+            blocked.append(diag)
+            return False
+        order[j - 1], order[j] = order[j], order[j - 1]
+        return True
+
+    # 1. Predicate pushdown: bubble each narrowing stage toward the
+    # leaf across non-narrowing stages (narrow-vs-narrow order is the
+    # reordering rule's job, with a cost argument).
+    order = list(range(n))
+    pushed: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for j in range(2, n):
+            p, q = order[j], order[j - 1]
+            if not _is_mover(facts[p]) or q == 0 or _is_mover(facts[q]):
+                continue
+            if try_swap("predicate-pushdown", order, j):
+                pushed.add(p)
+                changed = True
+    for p in sorted(pushed):
+        applied.append(
+            f"predicate-pushdown: {facts[p].label} moved to slot "
+            f"{order.index(p)}")
+
+    # 2. Filter reordering: most-selective-first inside each run of
+    # adjacent narrowing stages (plain bubble sort; every adjacent swap
+    # is individually proven, so a blocked pair simply stays put).
+    from .cost import estimate_plan
+
+    ests = estimate_plan(root, sketches=sketches)
+    sel = {p: (ests[p].selectivity if ests[p].selectivity is not None
+               else 1.0) for p in range(n)}
+    reordered: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for j in range(2, n):
+            p, q = order[j], order[j - 1]
+            if not _is_mover(facts[p]) or not _is_mover(facts[q]):
+                continue
+            if sel[p] < sel[q] and try_swap("filter-reorder", order, j):
+                reordered.add(p)
+                changed = True
+    for p in sorted(reordered):
+        applied.append(
+            f"filter-reorder: {facts[p].label} hoisted "
+            f"(selectivity {sel[p]:.4f})")
+
+    # 3. Projection pushdown: drop dead leaf columns right after the
+    # leaf.  Liveness is order-independent (a union over stage
+    # footprints), so the permutation above does not change it.
+    steps: List[Tuple] = []
+    if order != list(range(n)):
+        steps.append(("permute", tuple(order)))
+    final_schema = tuple(report.states[-1].schema.keys())
+    live = PV.live_columns(facts[1:], final_schema)
+    if live is None:
+        bad = next((f for f in facts[1:]
+                    if f.barrier or f.reads is None
+                    or (f.op == "Join" and f.fallback_writes is None)),
+                   None)
+        if bad is not None:
+            blocked.append(ProvenanceDiagnostic(
+                "projection-pushdown", bad.label,
+                f"{bad.op} has an unknown column footprint — no liveness "
+                f"claim is sound"))
+    else:
+        leaf_cols = list(report.states[0].schema.keys())
+        dead = tuple(c for c in leaf_cols if c not in live)
+        if dead and len(dead) < len(leaf_cols):
+            steps.append(("drop_after_leaf", dead))
+            applied.append(
+                f"projection-pushdown: drop {list(dead)} after "
+                f"{facts[0].label}")
+
+    # The bubble passes re-attempt stuck pairs once per sweep; keep the
+    # first refusal only.
+    seen: set = set()
+    unique_blocked = tuple(
+        d for d in blocked
+        if (d.rule, d.stage, d.message) not in seen
+        and not seen.add((d.rule, d.stage, d.message)))
+
+    if not steps:
+        return RewriteResult(root, report, report, None, tuple(applied),
+                             unique_blocked)
+
+    recipe = PlanRecipe(tuple(steps), tuple(sorted(consumed)))
+    new_root = apply_recipe(root, recipe)
+    opt_report = verify_plan(new_root)
+    if (opt_report.ok != report.ok
+            or opt_report.predicts_empty != report.predicts_empty):
+        raise RewriteVerdictMismatch(
+            f"rewritten plan verdict (ok={opt_report.ok}, "
+            f"predicts_empty={opt_report.predicts_empty}) diverged from "
+            f"original (ok={report.ok}, "
+            f"predicts_empty={report.predicts_empty}); rewrite discarded")
+    return RewriteResult(new_root, opt_report, report, recipe,
+                         tuple(applied), unique_blocked)

@@ -392,7 +392,14 @@ class StringColumn:
     @classmethod
     def from_values(cls, values: Sequence[str], device) -> "StringColumn":
         dictionary, codes = encode_strings(values)
-        return cls(dictionary, jax.device_put(codes, device))
+        # The encoder just saw every cell: record absence while it is a
+        # free host scan.  A definite ``False`` here is what lets the
+        # verifier prove columns PRESENT — the presence obligations the
+        # plan rewriter's pushdown proofs consume (analysis/rewrite.py).
+        has_absent = bool(codes.size) and bool(codes.min() < 0)
+        return cls(
+            dictionary, jax.device_put(codes, device), _has_absent=has_absent
+        )
 
     @classmethod
     def constant(cls, value: str, n: int, device) -> "StringColumn":

@@ -27,6 +27,13 @@ module caches by shape:
   cache because a same-shape plan lowers to the same jaxpr.  The
   ``lowered`` counter ticks only on misses — a warm workload asserts
   zero recompiles by watching it stay flat.
+* **Admission also optimizes.**  After verification, the miss path runs
+  the verifier-checked rewriter (:mod:`csvplus_tpu.analysis.rewrite`)
+  once per shape and stores the resulting :class:`PlanRecipe` on the
+  executable: the *optimized* plan executes under the *original*
+  structural key.  ``CSVPLUS_OPTIMIZE=0`` disables the rewriter and
+  restores the byte-identical unrewritten behavior; a rewriter failure
+  is counted (``optimize_failed``) and the shape runs unrewritten.
 * **LRU-bounded.**  ``CSVPLUS_PLANCACHE_SIZE`` entries (default 256);
   hit/miss/evict/reject counters exported via :meth:`PlanCache.stats`.
 
@@ -126,20 +133,40 @@ class PlanExecutable:
     ``run(root)`` executes the SUBMITTED root (same shape, possibly
     different data) through the preverified executor path — the stored
     report vouches for the shape, so verification does not rerun.
+
+    ``recipe`` is the provenance-proven rewrite computed once at
+    admission (:func:`csvplus_tpu.analysis.rewrite.optimize_plan`):
+    the OPTIMIZED plan is what executes, under the ORIGINAL structural
+    key.  Replay is data-only (a slot permutation + a leaf drop list),
+    so every submission lowers to the same optimized jaxpr and the
+    warm path still never recompiles.  The recipe's presence
+    obligations are re-checked against each submitted leaf
+    (the structural key pins schema but not cell presence); a
+    submission that fails them runs unrewritten — correct, just not
+    optimized.
     """
 
-    __slots__ = ("key", "report", "runs")
+    __slots__ = ("key", "report", "recipe", "runs", "unoptimized_runs")
 
-    def __init__(self, key: Tuple, report):
+    def __init__(self, key: Tuple, report, recipe=None):
         self.key = key
         self.report = report
+        self.recipe = recipe
         self.runs = 0
+        self.unoptimized_runs = 0  # presence obligations failed
 
     def run(self, root: P.PlanNode):
         """Execute and materialize; returns the result DeviceTable."""
         from ..columnar.exec import execute_plan_view
 
         self.runs += 1  # stats only; a lost increment under races is benign
+        if self.recipe is not None:
+            from ..analysis.rewrite import apply_recipe, leaf_presence_ok
+
+            if leaf_presence_ok(root, self.recipe.require_present):
+                root = apply_recipe(root, self.recipe)
+            else:
+                self.unoptimized_runs += 1
         return execute_plan_view(root, preverified=True).materialize()
 
 
@@ -159,6 +186,8 @@ class PlanCache:
         self.evictions = 0
         self.rejected = 0
         self.lowered = 0  # shapes verified+admitted (ticks only on miss)
+        self.optimized = 0  # admitted shapes that carry a rewrite recipe
+        self.optimize_failed = 0  # rewriter raised; shape runs unrewritten
 
     def __len__(self) -> int:
         with self._lock:
@@ -187,13 +216,29 @@ class PlanCache:
                 self.misses += 1
                 self.rejected += 1
             raise PlanRejected(report.errors)
-        exe = PlanExecutable(key, report)
+        recipe = None
+        from ..analysis.rewrite import optimize_enabled, optimize_plan
+
+        if optimize_enabled():
+            try:
+                result = optimize_plan(root, report)
+                recipe = result.recipe
+            except Exception:
+                # The rewriter is advisory: a prover bug (verdict
+                # mismatch, unexpected node) must never cost an
+                # admission.  The shape runs unrewritten; the counter
+                # keeps the failure visible in stats().
+                with self._lock:
+                    self.optimize_failed += 1
+        exe = PlanExecutable(key, report, recipe)
         with self._lock:
             self.misses += 1
             existing = self._entries.get(key)
             if existing is not None:
                 return existing  # racing insert won; reuse it
             self.lowered += 1
+            if recipe is not None:
+                self.optimized += 1
             self._entries[key] = exe
             while len(self._entries) > self.size:
                 self._entries.popitem(last=False)
@@ -217,5 +262,7 @@ class PlanCache:
                 "evictions": self.evictions,
                 "rejected": self.rejected,
                 "lowered": self.lowered,
+                "optimized": self.optimized,
+                "optimize_failed": self.optimize_failed,
                 "hit_rate": round(self.hits / total, 4) if total else None,
             }

@@ -49,6 +49,7 @@ from __future__ import annotations
 from typing import List, Sequence
 
 from .. import plan as P
+from ..analysis import provenance as PV
 from ..errors import CsvPlusError
 from ..exprs import Rename, SetValue, Update
 
@@ -66,36 +67,48 @@ class ViewRejected(CsvPlusError):
 
 
 #: Chain ops with a per-tier delta rule (see the module docstring).
+#: The tuple is documentation/export; the gate itself decides from the
+#: provenance domain's facts (``analysis.provenance.delta_safe`` — the
+#: same row-linear/order-preserving/non-aborting classification,
+#: defined once), so the two can never drift.
 DELTA_OPS = (P.Filter, P.MapExpr, P.SelectCols, P.DropCols, P.Join, P.Except)
 
 
 def _expr_diags(label: str, expr, key_columns: Sequence[str]) -> List[str]:
-    """Why a Map stage's expr would break source-key survival ([] = safe)."""
+    """Why a Map stage's expr would break source-key survival ([] = safe).
+
+    The column footprint (which names the expr writes or removes) comes
+    from the provenance domain (:func:`~csvplus_tpu.analysis.provenance.
+    expr_facts`) — one definition shared with the rewriter; only the
+    per-shape diagnostic wording lives here."""
     keys = set(key_columns)
+    if isinstance(expr, Update):
+        out: List[str] = []
+        for sub in expr.exprs:
+            out.extend(_expr_diags(label, sub, key_columns))
+        return out
+    ef = PV.expr_facts(expr)
+    if not ef.known:
+        return [
+            f"{label}: no delta rule for map expr {type(expr).__name__!r} "
+            f"(known-safe: Rename/SetValue/Update off the key columns)"
+        ]
+    bad = keys & (ef.writes | ef.removes)
     if isinstance(expr, Rename):
-        bad = keys & (set(expr.mapping) | set(expr.mapping.values()))
+        # Rename READS both sides of every pair (merge-with-fallback),
+        # so a key appearing as old OR new name is touched.
         if bad:
             return [
                 f"{label}: Rename touches source key column(s) "
                 f"{sorted(bad)} — retraction needs them intact"
             ]
         return []
-    if isinstance(expr, SetValue):
-        if expr.column in keys:
-            return [
-                f"{label}: SetValue overwrites source key column "
-                f"{expr.column!r} — retraction needs it intact"
-            ]
-        return []
-    if isinstance(expr, Update):
-        out: List[str] = []
-        for sub in expr.exprs:
-            out.extend(_expr_diags(label, sub, key_columns))
-        return out
-    return [
-        f"{label}: no delta rule for map expr {type(expr).__name__!r} "
-        f"(known-safe: Rename/SetValue/Update off the key columns)"
-    ]
+    if bad:  # SetValue (the only other known expr writes one column)
+        return [
+            f"{label}: SetValue overwrites source key column "
+            f"{expr.column!r} — retraction needs it intact"
+        ]
+    return []
 
 
 def check_view_plan(root: P.PlanNode, key_columns: Sequence[str],
@@ -120,7 +133,8 @@ def check_view_plan(root: P.PlanNode, key_columns: Sequence[str],
         )
     for pos, node in enumerate(chain[1:], start=1):
         label = P.stage_label(pos, node)
-        if not isinstance(node, DELTA_OPS):
+        facts = PV.stage_facts(pos, node)
+        if not PV.delta_safe(facts):
             diags.append(
                 f"{label}: no incremental delta rule for "
                 f"{type(node).__name__} (positional/aborting ops cannot "
@@ -130,14 +144,14 @@ def check_view_plan(root: P.PlanNode, key_columns: Sequence[str],
         if isinstance(node, P.MapExpr):
             diags.extend(_expr_diags(label, node.expr, key_columns))
         elif isinstance(node, P.SelectCols):
-            missing = [c for c in key_columns if c not in node.columns]
+            _, missing = PV.key_clobbers(facts, key_columns)
             if missing:
                 diags.append(
                     f"{label}: projects away source key column(s) "
                     f"{missing} — retraction needs them in the output"
                 )
         elif isinstance(node, P.DropCols):
-            dropped = [c for c in key_columns if c in node.columns]
+            dropped, _ = PV.key_clobbers(facts, key_columns)
             if dropped:
                 diags.append(
                     f"{label}: drops source key column(s) {dropped} — "

@@ -828,6 +828,7 @@ def test_hygiene_allowance_lists_start_empty():
         EAGER001_ALLOWED,
         FAULT001_ALLOWED,
         IO001_ALLOWED,
+        LOCK001_ALLOWED,
         THREAD001_ALLOWED,
         TRACE001_ALLOWED,
     )
@@ -837,6 +838,7 @@ def test_hygiene_allowance_lists_start_empty():
     assert THREAD001_ALLOWED == frozenset()
     assert FAULT001_ALLOWED == frozenset()
     assert IO001_ALLOWED == frozenset()
+    assert LOCK001_ALLOWED == frozenset()
 
 
 # ---- IO001 (the durability boundary, ISSUE 10) -----------------------
@@ -912,6 +914,235 @@ def test_thread001_covers_wal_and_tombstone_entries():
     src2 = src.replace("append_record", "delete")
     findings2 = lint_source(src2, "lsm.py")
     assert findings2 and all(f.code == "THREAD001" for f in findings2)
+
+
+# ---- LOCK001 (the lock-ordering boundary, ISSUE 16) ------------------
+
+LOCK_NESTED = """
+import threading
+
+_reg_lock = threading.Lock()
+_sketch_lock = threading.Lock()
+
+def record(key):
+    with _reg_lock:
+        with _sketch_lock:
+            pass
+"""
+
+LOCK_ROUNDS_OK = """
+import threading
+
+_reg_lock = threading.Lock()
+_sketch_lock = threading.Lock()
+
+def record(key):
+    with _reg_lock:
+        pass
+    with _sketch_lock:
+        pass
+"""
+
+LOCK_ATTR_NESTED = """
+class Dispatcher:
+    def submit(self, item):
+        with self._lock:
+            with self._qlock:
+                pass
+"""
+
+LOCK_CANONICAL_OK = """
+class MaterializedView:
+    def refresh(self):
+        with self._lock:
+            with self._qlock:
+                pass
+"""
+
+LOCK_CANONICAL_REVERSED = """
+class MaterializedView:
+    def enqueue(self):
+        with self._qlock:
+            with self._lock:
+                pass
+"""
+
+LOCK_NESTED_DEF_OK = """
+import threading
+
+_reg_lock = threading.Lock()
+_sketch_lock = threading.Lock()
+
+def record(key):
+    with _reg_lock:
+        def later():
+            with _sketch_lock:
+                pass
+        return later
+"""
+
+LOCK_SUPPRESSED = """
+import threading
+
+_reg_lock = threading.Lock()
+_sketch_lock = threading.Lock()
+
+def record(key):  # analysis: allow[LOCK001]
+    with _reg_lock:
+        with _sketch_lock:
+            pass
+"""
+
+
+def test_lock001_fires_on_nested_module_locks():
+    (f,) = lint_source(LOCK_NESTED, "joinskew.py")
+    assert f.code == "LOCK001"
+    assert "`joinskew._sketch_lock`" in f.message
+    assert "holding `joinskew._reg_lock`" in f.message
+    # same pair in ONE with statement: acquired left to right, same flag
+    one_with = LOCK_NESTED.replace(
+        "with _reg_lock:\n        with _sketch_lock:",
+        "with _reg_lock, _sketch_lock:"
+    )
+    (g,) = lint_source(one_with, "joinskew.py")
+    assert g.code == "LOCK001"
+
+
+def test_lock001_fires_on_nested_attr_locks():
+    (f,) = lint_source(LOCK_ATTR_NESTED, "serve.py")
+    assert f.code == "LOCK001" and "`Dispatcher._qlock`" in f.message
+
+
+def test_lock001_silent_on_rounds_canonical_pair_and_nested_defs():
+    # sequential lock rounds: the repo's discipline, never flagged
+    assert lint_source(LOCK_ROUNDS_OK, "joinskew.py") == []
+    # the one documented pair in LOCK001_CANONICAL_ORDER
+    assert lint_source(LOCK_CANONICAL_OK, "view.py") == []
+    # a nested def body does not execute under the outer with
+    assert lint_source(LOCK_NESTED_DEF_OK, "joinskew.py") == []
+
+
+def test_lock001_canonical_pair_is_ordered_not_symmetric():
+    (f,) = lint_source(LOCK_CANONICAL_REVERSED, "view.py")
+    assert f.code == "LOCK001"
+    assert "`MaterializedView._lock`" in f.message
+
+
+def test_lock001_suppression_on_def_line():
+    assert lint_source(LOCK_SUPPRESSED, "joinskew.py") == []
+
+
+# ---- provenance domain edge cases (ISSUE 16) -------------------------
+
+
+def _facts(node, pos=1):
+    from csvplus_tpu.analysis import stage_facts
+
+    return stage_facts(pos, node)
+
+
+def test_provenance_expr_facts_shadowing():
+    from csvplus_tpu.analysis.provenance import expr_facts
+    from csvplus_tpu.exprs import Update
+
+    sv = expr_facts(SetValue("name", "x"))
+    assert sv.known and sv.writes == {"name"} and not sv.reads
+
+    rn = expr_facts(Rename({"old": "new"}))
+    # merge-with-fallback READS both sides; old is removed, new written
+    assert rn.reads == {"old", "new"}
+    assert rn.writes == {"new"} and rn.removes == {"old"}
+
+    up = expr_facts(Update(SetValue("a", "1"), Rename({"a": "b"})))
+    assert up.known and up.writes == {"a", "b"} and up.removes == {"a"}
+
+    unknown = expr_facts(lambda r: r)
+    assert not unknown.known
+
+
+def test_provenance_key_destroying_projections():
+    from csvplus_tpu.analysis import stage_facts
+    from csvplus_tpu.analysis.provenance import key_clobbers
+
+    sel = stage_facts(1, P.SelectCols(P.Scan(None), ("name",)))
+    assert key_clobbers(sel, ["id"]) == ([], ["id"])
+    drop = stage_facts(1, P.DropCols(P.Scan(None), ("id",)))
+    assert key_clobbers(drop, ["id"]) == (["id"], [])
+    # Join writes its keys but the matched VALUES are the stream's own:
+    # retraction-by-key still works, so Join never clobbers
+    join = stage_facts(1, P.Join(P.Scan(None), fake_index(
+        {"id": PRESENT(), "name": PRESENT()}, ["id"]), ("id",)))
+    assert key_clobbers(join, ["id"]) == ([], [])
+
+
+def test_provenance_multiplicity_and_abort_bits():
+    from csvplus_tpu.analysis.provenance import EXPAND, NARROW, delta_safe
+
+    val = _facts(P.Validate(P.Scan(None), Like({"id": "1"}), "bad"))
+    assert val.aborting and val.may_error and not delta_safe(val)
+
+    exc = _facts(P.Except(P.Scan(None), fake_index(
+        {"id": PRESENT()}, ["id"]), ("id",)))
+    assert exc.multiplicity == NARROW and exc.may_error and delta_safe(exc)
+
+    join = _facts(P.Join(P.Scan(None), fake_index(
+        {"id": PRESENT(), "name": PRESENT()}, ["id"]), ("id",)))
+    assert join.multiplicity == EXPAND
+    assert join.fallback_writes == {"name"}  # index cols minus keys
+
+    top = _facts(P.Top(P.Scan(None), 5))
+    assert not top.row_linear and not delta_safe(top)
+
+
+def test_provenance_lookup_leaf_and_unknown_nodes():
+    from csvplus_tpu.analysis.provenance import PRESERVE
+
+    lk = _facts(P.Lookup(None, 3, 9), pos=0)
+    assert lk.multiplicity == PRESERVE and not lk.barrier
+
+    class Mystery:
+        pass
+
+    my = _facts(Mystery())
+    assert my.barrier and not my.row_linear and my.reads is None
+    # a Map over an unrecognized expr keeps the delta gate's per-expr
+    # diagnostic path (row-linear) but blocks rewrites (barrier)
+    mp = _facts(P.MapExpr(P.Scan(None), lambda r: r))
+    assert mp.barrier and mp.row_linear and mp.reads is None
+
+
+def test_provenance_live_columns_and_swap_proofs():
+    from csvplus_tpu.analysis.provenance import (
+        live_columns,
+        prove_swap_before,
+        stage_facts,
+    )
+
+    filt = stage_facts(2, P.Filter(P.Scan(None), Like({"cat": "a"})))
+    setv = stage_facts(1, P.MapExpr(P.Scan(None), SetValue("cat", "x")))
+    sel = stage_facts(1, P.SelectCols(P.Scan(None), ("id", "qty")))
+    drop = stage_facts(1, P.DropCols(P.Scan(None), ("pad",)))
+
+    # clobber: the filter reads what the map writes
+    d = prove_swap_before("t", filt, setv, lambda c: True)
+    assert d is not None and "writes/removes ['cat']" in d.message
+    # projection: the filter's column does not survive SelectCols
+    d = prove_swap_before("t", filt, sel, lambda c: True)
+    assert d is not None and "projects away ['cat']" in d.message
+    # SelectCols' own per-row error needs presence proven
+    filt_id = stage_facts(2, P.Filter(P.Scan(None), Like({"id": "1"})))
+    d = prove_swap_before("t", filt_id, sel, lambda c: False)
+    assert d is not None and "per-row errors" in d.message
+    assert prove_swap_before("t", filt_id, sel, lambda c: True) is None
+    # DropCols is error-free: provable with no presence facts at all
+    assert prove_swap_before("t", filt, drop, lambda c: False) is None
+
+    # liveness: only read/written/output columns are live
+    live = live_columns([setv, filt, sel], ("id", "qty"))
+    assert live == {"cat", "id", "qty"}
+    # any barrier poisons the liveness claim
+    mp = stage_facts(1, P.MapExpr(P.Scan(None), lambda r: r))
+    assert live_columns([mp], ("id",)) is None
 
 
 # ---- the `make analyze` snapshot -------------------------------------
