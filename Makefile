@@ -1,7 +1,7 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal bench-view bench-opt trace-smoke obs-smoke skew-smoke chaos check dryrun example coldcheck lint analyze asan
+.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal bench-view bench-opt trace-smoke obs-smoke skew-smoke multiway-smoke chaos check dryrun example coldcheck lint analyze asan
 
 test:
 	python -m pytest tests/ -x -q
@@ -10,7 +10,7 @@ test:
 # differential, mutable-index storage bench, materialized-view bench,
 # telemetry-plane smoke, skew-aware-join smoke — the set a change must
 # keep green before review.
-check: test lint chaos bench-delta bench-wal bench-view bench-opt obs-smoke skew-smoke
+check: test lint chaos bench-delta bench-wal bench-view bench-opt obs-smoke skew-smoke multiway-smoke
 
 # Static analysis gate (docs/ANALYSIS.md).  The repo AST lint (ctypes
 # boundary + jit retrace rules) always runs; ruff and mypy run when
@@ -78,7 +78,14 @@ bench-micro:
 # and bitwise parity enforced in-run; its checked-in record
 # (NORTHSTAR_MESH_r07.json) is only (re)written when
 # CSVPLUS_BENCH_MESH_OUT_ZIPF is set.  CSVPLUS_BENCH_MESH_SKEW=0
-# skips the tier.
+# skips the tier.  A third MULTIWAY tier (ISSUE 17) runs the
+# cost-chosen single-pass multiway operator vs the cascaded-skew path
+# in one child over the same Zipf bytes — per-leg RSS watermarks,
+# bitwise parity, obs-diff stage attribution — gated by
+# join_rows_per_sec_warm_multiway with the same half-floor rule; its
+# checked-in record (NORTHSTAR_MESH_r08.json) is only (re)written when
+# CSVPLUS_BENCH_MESH_OUT_MULTIWAY is set.
+# CSVPLUS_BENCH_MESH_MULTIWAY=0 skips the tier.
 bench-mesh:
 	python bench.py --bench-mesh
 
@@ -187,6 +194,18 @@ obs-smoke:
 # floor for the skew path lives in the bench-mesh skew tier.
 skew-smoke:
 	python bench.py --skew-smoke
+
+# Single-pass multiway join smoke (ISSUE 17): the cost-chosen fused
+# 3-way join on the hermetic 8-device mesh — the rewriter must FUSE
+# the Join->Join run (plan-cache `fused` counter, not the env flag),
+# the result must be BITWISE equal (positional per-column checksums)
+# to the CSVPLUS_MULTIWAY=0 cascade over the same Zipf-both-dims data,
+# the csvplus_join_multiway_* counter family must ride a metrics
+# scrape, and repeated warm fused executions must lower nothing
+# (RecompileWatch).  Seconds long; one JSON line; exits nonzero on any
+# gate failure.  The perf targets live in the bench-mesh multiway tier.
+multiway-smoke:
+	python bench.py --multiway-smoke
 
 # Fault-injection differential gate (docs/RESILIENCE.md): seeded fault
 # schedules against serve load, K-worker streamed ingest, and the

@@ -1749,6 +1749,196 @@ def _skew_smoke() -> int:
     return 0
 
 
+def _multiway_smoke() -> int:
+    """The `make multiway-smoke` tier (ISSUE 17): the single-pass
+    multiway join's correctness contract in seconds, hermetic 8-device
+    CPU mesh (the perf targets live in the `make bench-mesh` multiway
+    tier — this gate is the cheap every-`make check` correctness leg).
+
+    Gates, ONE JSON line on stdout, nonzero exit on any failure:
+
+    1. the rewriter actually FUSED: the cost model chooses the multiway
+       operator for the sharded 3-way chain and the plan cache's
+       ``fused`` counter records it (not assumed from the env flag);
+    2. bitwise parity: positional per-column checksums of the fused
+       3-way join are identical to the ``CSVPLUS_MULTIWAY=0`` cascade's
+       over the same Zipf(s=1.3)-both-dims data (hot keys in both
+       dimensions, partition tier engaged);
+    3. zero warm recompiles across repeated fused executions
+       (``RecompileWatch.assert_zero``);
+    4. the ``csvplus_join_multiway_*`` counter family landed in the
+       process-global registry and rides a metrics scrape.
+    """
+    if os.environ.get("CSVPLUS_MULTIWAY_SMOKE_HERMETIC") != "1":
+        env = dict(os.environ)
+        env["CSVPLUS_MULTIWAY_SMOKE_HERMETIC"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+    import numpy as np
+
+    import csvplus_tpu as cp
+    import csvplus_tpu.ops.join as J
+    from csvplus_tpu.columnar.ingest import source_from_table
+    from csvplus_tpu.columnar.table import DeviceTable
+    from csvplus_tpu.obs.joinskew import joinskew
+    from csvplus_tpu.obs.memory import host_header
+    from csvplus_tpu.obs.metrics import TelemetryPlane
+    from csvplus_tpu.obs.recompile import RecompileWatch
+    from csvplus_tpu.parallel.mesh import make_mesh
+    from csvplus_tpu.serve.plancache import PlanCache
+    from csvplus_tpu.utils.checksum import checksum_device_table
+
+    n_rows = int(os.environ.get("CSVPLUS_MULTIWAY_SMOKE_ROWS", 200_000))
+    n_keys = int(os.environ.get("CSVPLUS_MULTIWAY_SMOKE_KEYS", 20_000))
+    n_prods = 1_000
+    # engage the partition tier at smoke scale (dedicated process: the
+    # class-level override can't leak anywhere)
+    J.DeviceIndex.PARTITION_MIN_KEYS = 1
+
+    t0_all = time.perf_counter()
+    rng = np.random.default_rng(20160914)
+    # BOTH dimension keys are Zipf-skewed (permuted rank->key so hot
+    # keys don't cluster in one shard's range): the fused pass must
+    # route each dimension's heavy keys through its broadcast tier
+    cust = zipf_probe_values(
+        rng.permutation(n_keys), n_rows, s=1.3, seed=20260806
+    )
+    prod = zipf_probe_values(
+        rng.permutation(n_prods), n_rows, s=1.3, seed=20260807
+    )
+    mesh = make_mesh(8)
+    stream = DeviceTable.from_pylists(
+        {
+            "k": [f"c{int(v)}" for v in cust],
+            "p": [f"p{int(v)}" for v in prod],
+            "qty": [str(int(v) % 9) for v in cust],
+        },
+        device="cpu",
+    ).with_sharding(mesh)
+    cust_build = DeviceTable.from_pylists(
+        {
+            "k": [f"c{i}" for i in range(n_keys)],
+            "name": [f"n{i % 97}" for i in range(n_keys)],
+        },
+        device="cpu",
+    )
+    prod_build = DeviceTable.from_pylists(
+        {
+            "p": [f"p{i}" for i in range(n_prods)],
+            "price": [f"{(i % 990) / 10:.1f}" for i in range(n_prods)],
+        },
+        device="cpu",
+    )
+    cust_idx = cp.take(cust_build).index_on("k").sync()
+    prod_idx = cp.take(prod_build).index_on("p").sync()
+    plan = (
+        source_from_table(stream).join(cust_idx, "k").join(prod_idx, "p").plan
+    )
+    joinskew.reset()
+
+    def sums(cache):
+        out = cache.execute(plan)
+        assert out.nrows == n_rows, out.nrows
+        return checksum_device_table(out, sorted(out.columns), positional=True)
+
+    os.environ["CSVPLUS_MULTIWAY"] = "0"
+    cascade_sums = sums(PlanCache())
+    os.environ["CSVPLUS_MULTIWAY"] = "1"
+    cache = PlanCache()
+    fused_sums = sums(cache)  # cold fused pass compiles the multiway kernels
+    stats = cache.stats()
+    if stats.get("fused", 0) < 1:
+        sys.stderr.write(
+            f"multiway-smoke FAILED: rewriter did not fuse the 3-way"
+            f" chain (plan cache stats: {stats})\n"
+        )
+        return 1
+    if fused_sums != cascade_sums:
+        sys.stderr.write(
+            f"multiway-smoke FAILED: checksum parity broke:"
+            f" {fused_sums} != {cascade_sums}\n"
+        )
+        return 1
+    with RecompileWatch() as watch:
+        for _ in range(2):
+            if sums(cache) != cascade_sums:
+                sys.stderr.write(
+                    "multiway-smoke FAILED: warm fused pass diverged\n"
+                )
+                return 1
+        recompiles = watch.delta()
+    if recompiles:
+        sys.stderr.write(
+            f"multiway-smoke FAILED: warm recompiles {recompiles}\n"
+        )
+        return 1
+
+    # engagement evidence: the fused executions folded their counters
+    # under the '+'-joined dim label, and the family rides a scrape
+    counters = joinskew.counters_snapshot().get("k+p")
+    if (
+        counters is None
+        or counters.get("multiway_joins", 0) < 3
+        or counters.get("multiway_rows_out", 0)
+        != counters["multiway_joins"] * n_rows
+    ):
+        sys.stderr.write(
+            f"multiway-smoke FAILED: multiway counters never landed"
+            f" (counters: {counters})\n"
+        )
+        return 1
+    scrape = TelemetryPlane().registry.render()
+    missing = [
+        fam
+        for fam in (
+            "csvplus_join_multiway_total",
+            "csvplus_join_multiway_rows_in_total",
+            "csvplus_join_multiway_rows_out_total",
+            "csvplus_join_multiway_intermediate_rows_avoided_total",
+        )
+        if fam not in scrape
+    ]
+    if missing:
+        sys.stderr.write(
+            f"multiway-smoke FAILED: scrape is missing {missing}\n"
+        )
+        return 1
+    record = {
+        "metric": "multiway_smoke",
+        "value": round(
+            counters["multiway_intermediate_rows_avoided"]
+            / counters["multiway_joins"],
+            1,
+        ),
+        "unit": "intermediate_rows_avoided_per_join",
+        "rows": n_rows,
+        "n_keys": n_keys,
+        "n_prods": n_prods,
+        "zipf_s": 1.3,
+        "multiway_joins": counters["multiway_joins"],
+        "multiway_dims": counters["multiway_dims"],
+        "plancache_fused": stats["fused"],
+        "parity_bitwise": True,
+        "warm_recompiles": 0,
+        "wall_sec": round(time.perf_counter() - t0_all, 1),
+        **host_header(),
+    }
+    print(json.dumps(record), flush=True)
+    sys.stderr.write(
+        f"multiway-smoke ok: 3-way chain fused by the rewriter,"
+        f" {record['value']:,.0f} intermediate rows avoided per join,"
+        f" bitwise parity vs CSVPLUS_MULTIWAY=0, zero warm recompiles"
+        f" ({record['wall_sec']}s)\n"
+    )
+    return 0
+
+
 def _bench_mesh() -> int:
     """The `make bench-mesh` tier: the sharded north-star pipeline on
     the virtual 8-device CPU mesh, with the same floor contract as
@@ -2008,6 +2198,137 @@ def _bench_mesh() -> int:
         f" (naive {zrec.get('join_rows_per_sec_warm_naive', 0):,.0f},"
         f" speedup {speedup:,.2f}x, floor {floor_z:,.0f}) | bitwise"
         f" parity | (n={zrows})\n"
+    )
+
+    # ---- multiway tier (ISSUE 17): the cost-chosen single-pass
+    # multiway operator vs the cascaded-skew path in the SAME child
+    # run over the same Zipf bytes, gated by the
+    # join_rows_per_sec_warm_multiway floor with the identical
+    # half-floor rule.  CSVPLUS_BENCH_MESH_MULTIWAY_ROWS sizes it
+    # (default = the uniform tier's rows); CSVPLUS_BENCH_MESH_OUT_MULTIWAY
+    # names the artifact (default none, so a CI gate run cannot
+    # overwrite the checked-in NORTHSTAR_MESH_r08.json record);
+    # CSVPLUS_BENCH_MESH_MULTIWAY=0 skips the tier. ----
+    if os.environ.get("CSVPLUS_BENCH_MESH_MULTIWAY", "1") == "0":
+        sys.stderr.write("bench[mesh] multiway tier skipped (env)\n")
+        return 0
+    mrows = int(os.environ.get("CSVPLUS_BENCH_MESH_MULTIWAY_ROWS", rows))
+    mw_out = os.environ.get("CSVPLUS_BENCH_MESH_OUT_MULTIWAY")
+    cmd = [
+        sys.executable,
+        os.path.join(repo, "examples", "northstar_mesh.py"),
+        str(mrows),
+        "--multiway",
+    ]
+    try:
+        child = subprocess.run(
+            cmd,
+            capture_output=True,
+            text=True,
+            timeout=max(_remaining() - 20, 120),
+        )
+    except subprocess.TimeoutExpired as e:
+        tail = (e.stderr.decode() if isinstance(e.stderr, bytes) else e.stderr) or ""
+        sys.stderr.write(
+            f"bench[mesh:multiway] FAILED: run timed out; stderr tail:"
+            f" {tail[-600:]}\n"
+        )
+        return 1
+    for line in (child.stderr or "").splitlines():
+        sys.stderr.write(f"bench[mesh:multiway] {line}\n")
+    mrec = None
+    for line in reversed((child.stdout or "").splitlines()):
+        try:
+            rec = json.loads(line)
+            if (
+                isinstance(rec, dict)
+                and rec.get("metric") == "northstar_mesh_threeway_join_multiway"
+            ):
+                mrec = rec
+                break
+        except ValueError:
+            continue
+    if mrec is None or child.returncode != 0:
+        sys.stderr.write(
+            f"bench[mesh:multiway] FAILED: rc={child.returncode}, no record"
+            f" line; stderr tail: {(child.stderr or '')[-600:]}\n"
+        )
+        return 1
+    try:
+        mrec["commit"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, cwd=repo, timeout=10,
+        ).stdout.strip() or None
+    except Exception:
+        pass
+    if mw_out:
+        with open(mw_out, "w") as f:
+            json.dump(mrec, f, indent=1)
+            f.write("\n")
+        sys.stderr.write(
+            f"bench[mesh:multiway]: artifact written to {mw_out}\n"
+        )
+
+    floor_m = 0.0
+    floor_m_rows = None
+    try:
+        with open(os.path.join(repo, "bench_mesh_floor.json")) as f:
+            fl = json.load(f)
+            floor_m = float(fl.get("join_rows_per_sec_warm_multiway", 0.0))
+            floor_m_rows = fl.get("multiway_rows")
+    except (OSError, ValueError):
+        pass
+    warm_m = float(mrec.get("join_rows_per_sec_warm_multiway", 0.0))
+    warm_c = float(mrec.get("join_rows_per_sec_warm_cascaded", 0.0))
+    print(
+        json.dumps(
+            {
+                "metric": "northstar_mesh_threeway_join_multiway",
+                "rows": mrec.get("rows"),
+                "value": warm_m,
+                "unit": "rows/s",
+                "join_rows_per_sec_warm_cascaded": warm_c,
+                "multiway_speedup": mrec.get("multiway_speedup"),
+                "rss_below_cascaded": mrec.get("rss_below_cascaded"),
+                "peak_host_rss_mb_multiway": (mrec.get("legs", {}).get(
+                    "multiway", {}
+                )).get("peak_host_rss_mb"),
+                "peak_host_rss_mb_cascaded": (mrec.get("legs", {}).get(
+                    "cascaded", {}
+                )).get("peak_host_rss_mb"),
+                "parity_bitwise": mrec.get("parity_bitwise"),
+                "backend": mrec.get("backend"),
+                "floor": floor_m,
+            }
+        ),
+        flush=True,
+    )
+    if floor_m and warm_m < floor_m / 2:
+        sys.stderr.write(
+            f"bench[mesh:multiway] REGRESSION: warm multiway join"
+            f" {warm_m:,.0f} rows/s is under half the floor"
+            f" ({floor_m:,.0f} rows/s at {floor_m_rows or '?'} rows)\n"
+        )
+        return 1
+    if not mrec.get("rss_below_cascaded"):
+        sys.stderr.write(
+            "bench[mesh:multiway] WARNING: multiway leg RSS peak was not"
+            " below the cascaded leg's at this tier (record runs gate on"
+            " the r08 artifact; the hard floor here is"
+            " join_rows_per_sec_warm_multiway)\n"
+        )
+    if warm_c and warm_m < warm_c:
+        sys.stderr.write(
+            f"bench[mesh:multiway] WARNING: multiway warm rate"
+            f" {warm_m:,.0f} rows/s under the cascaded leg's"
+            f" {warm_c:,.0f} at this tier\n"
+        )
+    sys.stderr.write(
+        f"bench[mesh:multiway] ok: warm multiway join {warm_m:,.0f} rows/s"
+        f" (cascaded {warm_c:,.0f}, floor {floor_m:,.0f}) | rss"
+        f" {(mrec.get('legs', {}).get('multiway', {})).get('peak_host_rss_mb', 0):,.0f}"
+        f" vs {(mrec.get('legs', {}).get('cascaded', {})).get('peak_host_rss_mb', 0):,.0f}"
+        f" MB | bitwise parity | (n={mrows})\n"
     )
     return 0
 
@@ -2589,4 +2910,10 @@ if __name__ == "__main__":
         # broadcast tier engaged, zero warm recompiles — the function
         # re-execs itself into the hermetic 8-device CPU env
         sys.exit(_skew_smoke())
+    if "--multiway-smoke" in sys.argv:
+        # single-pass multiway join smoke: rewriter fuses the 3-way
+        # chain, bitwise parity vs CSVPLUS_MULTIWAY=0, multiway counter
+        # family on the scrape, zero warm recompiles — the function
+        # re-execs itself into the hermetic 8-device CPU env
+        sys.exit(_multiway_smoke())
     main()

@@ -16,7 +16,9 @@
     ``make analyze`` contract.
 
 ``python -m csvplus_tpu.analysis explain [name...] [--json]``
-    Render the per-node provenance/cost/placement tables and the
+    Render the per-node provenance/cost/placement tables, the ranked
+    join orders, the multiway-vs-cascaded physical-operator cost
+    comparison (which form the rewriter chooses and why), and the
     rewrite decision for the named example chains (all of them with no
     names; ``--list`` prints the names) — the same fixed-width-table
     CLI shape as ``obs diff``.  Unknown names exit 2.

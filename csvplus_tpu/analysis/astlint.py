@@ -466,6 +466,10 @@ _WORKER_ENTRY_NAMES = (
     "on_join",
     "offer_build",
     "offer_build_sample",
+    # csvplus_tpu/obs/joinskew multiway entry point (ISSUE 17): the
+    # fused single-pass join's evidence mutator — same concurrency
+    # envelope as on_join (any thread executing a multiway join).
+    "on_multiway",
 )
 
 _EAGER_TRANSFORM_OPS = frozenset(

@@ -37,6 +37,7 @@ from .schema import placement_of_column
 
 __all__ = [
     "CostEstimate",
+    "choose_join_operator",
     "estimate_plan",
     "predicate_selectivity",
     "rank_join_orders",
@@ -130,6 +131,38 @@ def _sketch_fanout(sketch, n_build: float, d_build: int) -> Tuple[float, str]:
     return (n_build * sum_sq, f"sketch ({len(shares)} tracked keys)")
 
 
+def _probe_cost(index, sketches) -> Tuple[float, float, str, Optional[tuple]]:
+    """Price one build side's probe: expected per-row fanout, replicated
+    bytes when the broadcast tier pins the build table per shard, a
+    human note, and the ``device_index_static_info`` tuple.  Shared by
+    the unit ``Join`` estimate and the per-dimension fold of the fused
+    ``MultiwayJoin`` — one pricing model, two physical operators."""
+    info = device_index_static_info(index)
+    dev = getattr(index, "device_table", None)
+    n_build = float(getattr(getattr(dev, "table", None), "nrows", 0) or 0)
+    meta = info[3] if info is not None else None
+    d_build = (meta or {}).get("packed_keys") or max(
+        1, int(n_build) or DEFAULT_DISTINCT)
+    label = ",".join(info[1]) if info is not None and info[1] else None
+    sk = sketches.get(label) if label else None
+    if sk is not None:
+        fanout, note = _sketch_fanout(sk, n_build, d_build)
+    else:
+        fanout = n_build / max(1, d_build)
+        note = "uniform build keys (no sketch)"
+    replicated = 0.0
+    # Broadcast-tier build sides are replicated once per shard (the r06
+    # memory lesson): below the partition threshold the build table
+    # rides every device.
+    pmin = (meta or {}).get("partition_min_keys")
+    if pmin is not None and d_build < pmin and dev is not None:
+        tbl = getattr(dev, "table", None)
+        ncols = len(getattr(tbl, "columns", {}) or {})
+        replicated = n_build * ncols * BYTES_PER_CELL
+        note += "; broadcast-tier build (replicated per shard)"
+    return fanout, replicated, note, info
+
+
 def _placement_bucket(col) -> str:
     kind = placement_of_column(col).kind
     if kind in ("device", "sharded"):
@@ -202,37 +235,38 @@ def estimate_plan(
             rows *= sel
             note = "default anti-join survival"
         elif isinstance(node, P.Join):
-            info = device_index_static_info(node.index)
-            dev = getattr(node.index, "device_table", None)
-            n_build = float(getattr(getattr(dev, "table", None), "nrows", 0) or 0)
-            meta = info[3] if info is not None else None
-            d_build = (meta or {}).get("packed_keys") or max(
-                1, int(n_build) or DEFAULT_DISTINCT)
-            label = ",".join(info[1]) if info is not None and info[1] else None
-            sk = sketches.get(label) if label else None
-            if sk is not None:
-                fanout, note = _sketch_fanout(sk, n_build, d_build)
-            else:
-                fanout = n_build / max(1, d_build)
-                note = "uniform build keys (no sketch)"
+            fanout, rep, note, info = _probe_cost(node.index, sketches)
             rows *= max(fanout, MIN_SELECTIVITY)
-            # Broadcast-tier build sides are replicated once per shard
-            # (the r06 memory lesson): below the partition threshold the
-            # build table rides every device.
-            pmin = (meta or {}).get("partition_min_keys")
-            if pmin is not None and d_build < pmin and dev is not None:
-                tbl = getattr(dev, "table", None)
-                ncols = len(getattr(tbl, "columns", {}) or {})
-                replicated += n_build * ncols * BYTES_PER_CELL
-                note += "; broadcast-tier build (replicated per shard)"
+            replicated += rep
             # Index columns joining the schema.
             if info is not None:
-                kinds, keys = info[0], info[1]
+                kinds, meta = info[0], info[3]
                 place = (meta or {}).get("placement")
                 b = "device" if place is None or place.kind != "host" else "host"
                 for name in kinds:
                     bucket.setdefault(name, b)
                     distinct.setdefault(name, DEFAULT_DISTINCT)
+        elif isinstance(node, P.MultiwayJoin):
+            # One chain slot, N build sides: fanouts compose
+            # multiplicatively (exactly the cascade's row count — the
+            # fused operator is bitwise-equal by contract) but NO
+            # interior slot ever materializes, which is the whole point;
+            # choose_join_operator prices that difference explicitly.
+            dim_notes = []
+            for index, _cols in node.joins:
+                fanout, rep, dnote, info = _probe_cost(index, sketches)
+                rows *= max(fanout, MIN_SELECTIVITY)
+                replicated += rep
+                dim_notes.append(dnote)
+                if info is not None:
+                    kinds, meta = info[0], info[3]
+                    place = (meta or {}).get("placement")
+                    b = ("device" if place is None or place.kind != "host"
+                         else "host")
+                    for name in kinds:
+                        bucket.setdefault(name, b)
+                        distinct.setdefault(name, DEFAULT_DISTINCT)
+            note = f"multiway x{len(node.joins)}: " + " | ".join(dim_notes)
 
         # Schema evolution from provenance facts.
         if f.keeps_only is not None:
@@ -344,9 +378,85 @@ def rank_join_orders(
             total += r
         ranked.append({
             "order": [facts[p].label for p in perm],
+            # Original-chain slot indices in execution order — the
+            # executor-facing form: the rewriter turns the best provable
+            # entry into a ("permute", ...) recipe step (ISSUE 17).
+            "slots": list(perm),
+            "run": list(run),
             "est_intermediate_rows": round(total, 1),
             "provable": provable(perm),
             "submitted": list(perm) == run,
         })
     ranked.sort(key=lambda d: d["est_intermediate_rows"])
     return ranked
+
+
+def choose_join_operator(
+    root: P.PlanNode,
+    sketches: Optional[Dict[str, Any]] = None,
+) -> Optional[Dict[str, Any]]:
+    """Price the longest consecutive run of ``Join`` stages both ways —
+    cascaded (every interior intermediate table materializes: its full
+    estimated row count times its column count) versus the fused
+    single-pass multiway operator (per dimension, one int32
+    ``(lower, count)`` bounds pair per INPUT row, plus the expansion's
+    row-id vectors at the OUTPUT cardinality; no intermediate table) —
+    and return the cheaper physical operator.
+
+    Advisory like everything in this module: the rewriter only FUSES
+    when provenance licenses it (later keys PRESENT before the run) and
+    this function says the fused form is cheaper; ``explain`` renders
+    the comparison either way.  Returns ``None`` when the plan has no
+    run of two or more consecutive ``Join`` stages.
+    """
+    if sketches is None:
+        from ..obs.joinskew import joinskew
+
+        sketches = joinskew.build_sketches()
+    chain = P.linearize(root)
+    best: Tuple[int, int] = (0, 0)
+    i = 1
+    while i < len(chain):
+        if isinstance(chain[i], P.Join):
+            j = i
+            while j + 1 < len(chain) and isinstance(chain[j + 1], P.Join):
+                j += 1
+            if j + 1 - i > best[1] - best[0]:
+                best = (i, j + 1)
+            i = j + 1
+        else:
+            i += 1
+    lo, hi = best
+    n_dims = hi - lo
+    if n_dims < 2:
+        return None
+    ests = estimate_plan(root, sketches=sketches)
+    facts = [PV.stage_facts(i, n) for i, n in enumerate(chain)]
+    rows_in = ests[lo - 1].rows
+    rows_out = ests[hi - 1].rows
+    # Cascade: slots lo..hi-2 each materialize a full intermediate table
+    # (the run's FINAL output exists under both operators — excluded),
+    # and every level probes bounds (an int32 ``(lower, count)`` pair)
+    # over the rows ENTERING that level — which grow with each fanout.
+    cascade_bytes = sum(
+        ests[p].bytes_host + ests[p].bytes_device for p in range(lo, hi - 1)
+    ) + sum(
+        ests[p - 1].rows * 2.0 * BYTES_PER_CELL for p in range(lo, hi)
+    )
+    # Multiway: every dimension probes bounds over the ORIGINAL input
+    # rows; nothing else materializes beyond the final output both
+    # operators share.  (This is also why the cascade can win: when an
+    # early dimension drops most rows, its later levels probe fewer
+    # rows than the fused pass, which always probes all of rows_in.)
+    multiway_bytes = rows_in * 2.0 * BYTES_PER_CELL * n_dims
+    chosen = "multiway" if multiway_bytes < cascade_bytes else "cascade"
+    return {
+        "run": [facts[p].label for p in range(lo, hi)],
+        "slots": list(range(lo, hi)),
+        "dims": n_dims,
+        "est_rows_in": round(rows_in, 1),
+        "est_rows_out": round(rows_out, 1),
+        "cascade_intermediate_bytes": round(cascade_bytes, 1),
+        "multiway_bytes": round(multiway_bytes, 1),
+        "chosen": chosen,
+    }

@@ -199,6 +199,27 @@ def stage_facts(pos: int, node: P.PlanNode) -> StageFacts:
         return StageFacts(label, op, keys,
                           fallback_writes=fallback, multiplicity=EXPAND,
                           may_error=True)
+    if isinstance(node, P.MultiwayJoin):
+        # The fused operator inherits the cascade's facts dimension-wise:
+        # it reads every dimension's keys, and a column may be filled
+        # from ANY build side whose schema carries it as a non-key (the
+        # per-dimension stream-wins merges compose left to right, so the
+        # union of the per-join fallback sets is the sound fused set).
+        # Key pass-through is identical to the cascade: every surviving
+        # row had ALL key cells present, values bitwise the stream's own.
+        keys = frozenset().union(
+            *(frozenset(cols) for _idx, cols in node.joins)
+        )
+        fallback: Optional[frozenset] = _EMPTY
+        for idx, cols in node.joins:
+            info = device_index_static_info(idx)
+            if info is None or not info[2]:
+                fallback = None  # a build-side schema is unknown
+                break
+            fallback = fallback | (frozenset(info[0]) - frozenset(cols))
+        return StageFacts(label, op, keys,
+                          fallback_writes=fallback, multiplicity=EXPAND,
+                          may_error=True)
     # Unknown node type: total barrier — and no row-linearity claim.
     return StageFacts(label, op, None, row_linear=False,
                       order_preserving=False, barrier=True)
@@ -232,7 +253,7 @@ def key_clobbers(facts: StageFacts,
     are the stream's own, so retraction-by-key still addresses the same
     rows (matching the historical gate's behavior)."""
     keys = list(key_columns)
-    if facts.op in ("Join", "Except"):
+    if facts.op in ("Join", "Except", "MultiwayJoin"):
         return ([], [])
     clobbered = [k for k in keys if k in facts.clobbers]
     projected = []
@@ -366,6 +387,6 @@ def live_columns(facts: Sequence[StageFacts],
         if f.barrier or f.reads is None:
             return None
         live |= f.reads | f.writes
-        if f.fallback_writes is None and f.op == "Join":
+        if f.fallback_writes is None and f.op in ("Join", "MultiwayJoin"):
             return None
     return frozenset(live)

@@ -230,8 +230,15 @@ def rewrite_json(root, report) -> dict:
     recipe = None
     if result.recipe is not None:
         recipe = {
-            "steps": [[step[0], list(step[1])] for step in result.recipe.steps],
+            # fuse_joins carries scalar args; permute/drop carry tuples
+            "steps": [
+                [step[0]]
+                + [list(a) if isinstance(a, (list, tuple)) else a
+                   for a in step[1:]]
+                for step in result.recipe.steps
+            ],
             "require_present": list(result.recipe.require_present),
+            "join_order": list(result.recipe.join_order),
         }
     return {
         "applied": list(result.applied),
@@ -247,13 +254,14 @@ def plan_analysis_json(root) -> dict:
     """Everything the suite knows about one plan: verifier verdict,
     provenance table, cost table, join-order ranking, rewrite decision.
     The per-plan payload entry and the ``explain --json`` body."""
-    from .cost import rank_join_orders
+    from .cost import choose_join_operator, rank_join_orders
 
     report = verify_plan(root)
     d = report_json(report)
     d["provenance"] = provenance_json(root)
     d["cost"] = cost_json(root)
     d["join_orders"] = rank_join_orders(root, report, sketches={})
+    d["join_operator"] = choose_join_operator(root, sketches={})
     d["rewrite"] = rewrite_json(root, report)
     return d
 
@@ -321,6 +329,20 @@ def explain_text(name: str, root) -> str:
                 f"  {' -> '.join(cand['order']):<48}"
                 f" {cand['est_intermediate_rows']:>12.1f}  {mark}"
             )
+    op = d.get("join_operator")
+    if op is not None:
+        lines += [
+            "",
+            "physical join operator (cascaded vs single-pass multiway):",
+            f"  run: {' -> '.join(op['run'])} ({op['dims']} dims, "
+            f"est {op['est_rows_in']:.0f} rows in -> "
+            f"{op['est_rows_out']:.0f} out)",
+            f"  cascaded   : {op['cascade_intermediate_bytes']:>14.1f} B "
+            f"intermediate tables + per-level bounds",
+            f"  multiway   : {op['multiway_bytes']:>14.1f} B per-dimension "
+            f"bounds, no intermediate",
+            f"  chosen     : {op['chosen']}",
+        ]
     rw = d["rewrite"]
     lines.append("")
     if "error" in rw:
@@ -332,7 +354,12 @@ def explain_text(name: str, root) -> str:
             lines.append(f"  blocked {b['rule']} by {b['stage']}: {b['message']}")
         if rw["recipe"] is not None:
             steps = ", ".join(
-                f"{s[0]}({','.join(map(str, s[1]))})" for s in rw["recipe"]["steps"]
+                s[0] + "(" + ",".join(
+                    "[" + ",".join(map(str, a)) + "]"
+                    if isinstance(a, list) else str(a)
+                    for a in s[1:]
+                ) + ")"
+                for s in rw["recipe"]["steps"]
             )
             lines.append(
                 f"  recipe: {steps}; require_present="

@@ -1,6 +1,6 @@
-"""The verifier-checked plan rewriter (ISSUE 16, ROADMAP item 1).
+"""The verifier-checked plan rewriter (ISSUE 16 + 17, ROADMAP item 1).
 
-``optimize_plan`` applies exactly three rewrite rules, each one only
+``optimize_plan`` applies exactly five rewrite rules, each one only
 when the provenance domain (:mod:`.provenance`) PROVES it bitwise-safe
 against the executor's semantics, and records a typed
 :class:`~.provenance.ProvenanceDiagnostic` naming the blocking stage
@@ -12,6 +12,22 @@ for every refusal:
 * **filter reordering** — inside a run of adjacent narrowing stages,
   most-selective-first by the cost domain's estimates (each adjacent
   swap individually proven);
+* **join ordering** (ISSUE 17) — the cost domain's best *provable*
+  ranked ordering of the longest Join/Except run
+  (:func:`~.cost.rank_join_orders`) is realized by re-proving each
+  hoist with the live presence oracle; the chosen permutation is
+  recorded on the recipe (``join_order``) so the serving cache can
+  attribute replays to it;
+* **multiway fuse** (ISSUE 17) — a run of two or more consecutive
+  ``Join`` stages (post-permutation) collapses into one fused
+  :class:`~csvplus_tpu.plan.MultiwayJoin` physical operator when the
+  cost model prices the single-pass form cheaper
+  (:func:`~.cost.choose_join_operator`) AND every later dimension's
+  key columns are provably PRESENT on the stream entering the run —
+  the exact condition under which the cascade could neither fill a
+  later key from an earlier build side (stream-wins merge) nor raise
+  a key error at an intermediate row number the fused pass would
+  report differently.  ``CSVPLUS_MULTIWAY=0`` disables just this rule;
 * **projection pushdown** — leaf columns no stage reads or writes and
   the final schema omits are dropped right after the leaf
   (:func:`~.provenance.live_columns`); a ``DropCols`` there is a pure
@@ -60,6 +76,7 @@ __all__ = [
     "PlanRecipe",
     "RewriteResult",
     "RewriteVerdictMismatch",
+    "multiway_enabled",
     "optimize_enabled",
     "optimize_plan",
     "apply_recipe",
@@ -69,6 +86,14 @@ __all__ = [
 
 def optimize_enabled() -> bool:
     return os.environ.get("CSVPLUS_OPTIMIZE", "1") != "0"
+
+
+def multiway_enabled() -> bool:
+    """The multiway-fuse rule's own hatch (``CSVPLUS_MULTIWAY=0``),
+    nested under the global ``CSVPLUS_OPTIMIZE`` switch — the bench's
+    cascaded leg runs with the optimizer ON but the fuse OFF so both
+    legs share every other rewrite."""
+    return optimize_enabled() and os.environ.get("CSVPLUS_MULTIWAY", "1") != "0"
 
 
 class RewriteVerdictMismatch(CsvPlusError):
@@ -82,13 +107,21 @@ class RewriteVerdictMismatch(CsvPlusError):
 class PlanRecipe:
     """A data-only rewrite, replayable onto any root with the same
     structural cache key.  ``steps`` entries are ``("permute", slots)``
-    (a reordering of the :func:`~csvplus_tpu.plan.linearize` chain) or
+    (a reordering of the :func:`~csvplus_tpu.plan.linearize` chain),
+    ``("fuse_joins", lo, k)`` (collapse the ``k`` consecutive ``Join``
+    stages starting at post-permute slot ``lo`` into one
+    :class:`~csvplus_tpu.plan.MultiwayJoin`), or
     ``("drop_after_leaf", columns)``.  ``require_present`` are leaf
     columns whose cells must be PRESENT for the proofs to hold on the
-    submitted table."""
+    submitted table.  ``join_order`` is the cost-chosen execution order
+    of the plan's probe run (original chain slots) when the join-order
+    rule picked one — advisory metadata for the serving cache's
+    attribution counters and ``explain``; the executable form already
+    rides the permute step."""
 
     steps: Tuple[Tuple, ...]
     require_present: Tuple[str, ...] = ()
+    join_order: Tuple[int, ...] = ()
 
     def __bool__(self) -> bool:
         return bool(self.steps)
@@ -114,6 +147,15 @@ def apply_recipe(root: P.PlanNode, recipe: PlanRecipe) -> P.PlanNode:
     for step in recipe.steps:
         if step[0] == "permute":
             chain = [chain[i] for i in step[1]]
+        elif step[0] == "fuse_joins":
+            lo, k = int(step[1]), int(step[2])
+            run = chain[lo:lo + k]
+            if len(run) != k or not all(isinstance(s, P.Join) for s in run):
+                # the structural key pins op types, so this only fires on
+                # a recipe replayed against the wrong shape — refuse loud
+                raise ValueError("fuse_joins step does not address a Join run")
+            joins = tuple((s.index, tuple(s.columns)) for s in run)
+            chain[lo:lo + k] = [P.MultiwayJoin(run[0].child, joins)]
         elif step[0] == "drop_after_leaf":
             chain.insert(1, P.DropCols(chain[0], tuple(step[1])))
         else:  # unknown step kind: a recipe from a newer writer — refuse
@@ -233,7 +275,7 @@ def optimize_plan(root: P.PlanNode, report=None, *,
     # 2. Filter reordering: most-selective-first inside each run of
     # adjacent narrowing stages (plain bubble sort; every adjacent swap
     # is individually proven, so a blocked pair simply stays put).
-    from .cost import estimate_plan
+    from .cost import choose_join_operator, estimate_plan, rank_join_orders
 
     ests = estimate_plan(root, sketches=sketches)
     sel = {p: (ests[p].selectivity if ests[p].selectivity is not None
@@ -254,12 +296,95 @@ def optimize_plan(root: P.PlanNode, report=None, *,
             f"filter-reorder: {facts[p].label} hoisted "
             f"(selectivity {sel[p]:.4f})")
 
-    # 3. Projection pushdown: drop dead leaf columns right after the
-    # leaf.  Liveness is order-independent (a union over stage
-    # footprints), so the permutation above does not change it.
+    # 3. Join ordering: realize the cost domain's best PROVABLE ranked
+    # ordering of the longest probe run (``rank_join_orders`` has marked
+    # them since r16; nothing executed them until ISSUE 17).  Provable
+    # orderings preserve expander order, so only NARROW stages ever
+    # move — in most plans passes 1-2 already landed the target and this
+    # pass just records the chosen order; stragglers are bubbled with
+    # every hoist re-proven against the live oracle.
+    join_order: Tuple[int, ...] = ()
+    ranked = rank_join_orders(root, report, sketches=sketches)
+    best = next((r for r in ranked if r["provable"]), None)
+    if best is not None and not best["submitted"]:
+        run_set = set(best["run"])
+        target = list(best["slots"])
+        rank_of = {p: i for i, p in enumerate(target)}
+        changed = True
+        while changed:
+            changed = False
+            for j in range(2, n):
+                p, q = order[j], order[j - 1]
+                if p not in run_set or q not in run_set:
+                    continue
+                if rank_of[p] < rank_of[q] and try_swap(
+                        "join-order", order, j):
+                    changed = True
+        if [p for p in order if p in run_set] == target:
+            join_order = tuple(target)
+            applied.append(
+                f"join-order: probe run executes as {best['order']} "
+                f"(est {best['est_intermediate_rows']:.0f} intermediate "
+                f"rows)")
+
     steps: List[Tuple] = []
     if order != list(range(n)):
         steps.append(("permute", tuple(order)))
+
+    # 4. Multiway fuse (ISSUE 17): collapse a post-permutation run of
+    # >= 2 consecutive Joins into one single-pass MultiwayJoin when the
+    # cost model prices the fused operator cheaper AND every later
+    # dimension's key columns are provably PRESENT entering the run.
+    # The license is exactly the bitwise-parity condition: with later
+    # keys PRESENT, no earlier build side can fill them (stream-wins
+    # merge keeps present cells), and no per-level key check can raise
+    # at an intermediate row number the fused pass would report
+    # differently — so probing the original stream IS probing the
+    # cascade's intermediate.
+    if multiway_enabled():
+        permuted = apply_recipe(root, PlanRecipe(tuple(steps))) if steps else root
+        choice = choose_join_operator(permuted, sketches=sketches)
+        if choice is not None and choice["chosen"] == "multiway":
+            lo, k = int(choice["slots"][0]), int(choice["dims"])
+            pchain = P.linearize(permuted)
+            later = sorted(
+                {c for nd in pchain[lo + 1:lo + k] for c in nd.columns})
+            pre = [order[j] for j in range(1, lo)]
+
+            def fuse_ok(col: str) -> bool:
+                if col not in leaf_present:
+                    return False
+                for q in pre:
+                    f = facts[q]
+                    if f.barrier or f.reads is None:
+                        return False
+                    if col in f.writes or col in f.removes:
+                        return False
+                    if f.keeps_only is not None and col not in f.keeps_only:
+                        return False
+                return True
+
+            bad = [c for c in later if not fuse_ok(c)]
+            if bad:
+                blocked.append(ProvenanceDiagnostic(
+                    "multiway-fuse", facts[order[lo]].label,
+                    f"later-dimension key(s) {bad} not provably PRESENT "
+                    f"entering the run — the cascade could fill them from "
+                    f"an earlier build side or error at an intermediate "
+                    f"row"))
+            else:
+                consumed.update(later)
+                steps.append(("fuse_joins", lo, k))
+                applied.append(
+                    f"multiway-fuse: {k}-way run at slot {lo} (est "
+                    f"cascade {choice['cascade_intermediate_bytes']:.0f}B "
+                    f"intermediate vs multiway "
+                    f"{choice['multiway_bytes']:.0f}B)")
+
+    # 5. Projection pushdown: drop dead leaf columns right after the
+    # leaf.  Liveness is order-independent (a union over stage
+    # footprints, identical for the fused operator by construction), so
+    # neither the permutation nor the fuse above changes it.
     final_schema = tuple(report.states[-1].schema.keys())
     live = PV.live_columns(facts[1:], final_schema)
     if live is None:
@@ -293,7 +418,7 @@ def optimize_plan(root: P.PlanNode, report=None, *,
         return RewriteResult(root, report, report, None, tuple(applied),
                              unique_blocked)
 
-    recipe = PlanRecipe(tuple(steps), tuple(sorted(consumed)))
+    recipe = PlanRecipe(tuple(steps), tuple(sorted(consumed)), join_order)
     new_root = apply_recipe(root, recipe)
     opt_report = verify_plan(new_root)
     if (opt_report.ok != report.ok

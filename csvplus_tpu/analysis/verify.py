@@ -415,6 +415,9 @@ class _Verifier:
         if isinstance(node, P.Join):
             return self._transfer_join(node, state)
 
+        if isinstance(node, P.MultiwayJoin):
+            return self._transfer_multiway(node, state)
+
         if isinstance(node, P.Except):
             return self._transfer_except(node, state)
 
@@ -485,12 +488,11 @@ class _Verifier:
         return state
 
     def _index_info(
-        self, node
+        self, index, kind: str
     ) -> "Optional[Tuple[Dict[str, str], Tuple[str, ...], bool, Optional[dict]]]":
         from ..ops.join import device_index_static_info
 
-        kind = type(node).__name__.lower()
-        info = device_index_static_info(node.index)
+        info = device_index_static_info(index)
         if info is None or not info[2]:
             self.diag(
                 "unlowerable",
@@ -576,8 +578,10 @@ class _Verifier:
                 "— answers replicate back to the stream device (benign)",
             )
 
-    def _check_keys(self, node, state: NodeState, what: str, index_kinds) -> None:
-        for c in node.columns:
+    def _check_keys(
+        self, columns, state: NodeState, what: str, index_kinds
+    ) -> None:
+        for c in columns:
             self._resolve_required(state, c, f"{what} key")
             info = state.schema.get(c)
             if info is not None:
@@ -599,18 +603,27 @@ class _Verifier:
                         "fallback) at lowering",
                     )
 
-    def _transfer_join(self, node: P.Join, state: NodeState) -> NodeState:
-        info = self._index_info(node)
+    def _join_schema_step(
+        self, index, columns, state: NodeState, what: str
+    ) -> NodeState:
+        """One build side's full join transfer: key resolution, probe
+        placement, empty-stream model check, and the output schema.  The
+        unit ``Join`` applies once and ``MultiwayJoin`` folds per
+        dimension IN SPEC ORDER — the fused operator's abstract
+        semantics are exactly the cascade's (same card lattice walk,
+        same presence/lane/placement outcomes), which is what makes the
+        rewriter's verdict-equivalence re-check hold by construction."""
+        info = self._index_info(index, what)
         index_kinds = info[0] if info is not None else None
-        self._check_keys(node, state, "join", index_kinds)
+        self._check_keys(columns, state, what, index_kinds)
         self._check_placement_probe(
-            state, info[3] if info is not None else None, "join"
+            state, info[3] if info is not None else None, what
         )
         if not self.model.join_empty_total and state.card.may_be_empty:
             self.diag(
                 "empty-relation",
                 "error",
-                "join over a possibly-empty stream requires the executor's "
+                f"{what} over a possibly-empty stream requires the executor's "
                 "nrows==0 early-out (join_tables)",
             )
         # the joined relation materializes on the STREAM's layout (the
@@ -627,16 +640,26 @@ class _Verifier:
                 out[n] = ColInfo("str", Presence.MAYBE, placement=stream_place)
             else:
                 out[n] = replace(i, presence=Presence.MAYBE)
-        for c in node.columns:
+        for c in columns:
             if c in out:
                 out[c] = replace(out[c], presence=Presence.PRESENT)
         card = Card.EMPTY if state.card is Card.EMPTY else Card.MAYBE_EMPTY
         return NodeState(out, card)
 
+    def _transfer_join(self, node: P.Join, state: NodeState) -> NodeState:
+        return self._join_schema_step(node.index, node.columns, state, "join")
+
+    def _transfer_multiway(
+        self, node: P.MultiwayJoin, state: NodeState
+    ) -> NodeState:
+        for index, columns in node.joins:
+            state = self._join_schema_step(index, columns, state, "join")
+        return state
+
     def _transfer_except(self, node: P.Except, state: NodeState) -> NodeState:
-        info = self._index_info(node)
+        info = self._index_info(node.index, "except")
         index_kinds = info[0] if info is not None else None
-        self._check_keys(node, state, "except", index_kinds)
+        self._check_keys(node.columns, state, "except", index_kinds)
         self._check_placement_probe(
             state, info[3] if info is not None else None, "except"
         )

@@ -286,6 +286,36 @@ def _exec_stage(view: "_View", node: P.PlanNode) -> "_View":
             joined.nrows,
             identity=join_cols_len == joined.nrows,
         )
+    elif isinstance(node, P.MultiwayJoin):
+        # Fused single-pass multiway join (ISSUE 17): every dimension's
+        # keys validate against the ORIGINAL stream (the rewriter's
+        # fusion license proves later keys PRESENT, so the cascade could
+        # not have observed different cells), then one materialize feeds
+        # one expansion — no intermediate table.
+        specs = []
+        for index, columns in node.joins:
+            dev_index = index.device_table
+            if dev_index is None or not dev_index.supported:
+                raise UnsupportedPlan(
+                    "join build side has no packed device index"
+                )
+            _check_key_cells(view, columns)
+            specs.append((dev_index, tuple(columns)))
+        stream = view.materialize()
+        try:
+            joined = J.multiway_join(stream, specs)
+        except MissingColumnError as e:  # backstop; _check_key_cells covers it
+            raise DataSourceError(0, e) from e
+        join_cols_len = (
+            len(next(iter(joined.columns.values()))) if joined.columns else 0
+        )
+        view = _View(
+            dict(joined.columns),
+            jnp.arange(joined.nrows, dtype=jnp.int32),
+            joined.device,
+            joined.nrows,
+            identity=join_cols_len == joined.nrows,
+        )
     elif isinstance(node, P.Except):
         dev_index = node.index.device_table
         if dev_index is None or not dev_index.supported:

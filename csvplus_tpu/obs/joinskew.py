@@ -56,16 +56,47 @@ class JoinSkewStats:
         with self._lock:
             c = self._counters.get(label)
             if c is None:
-                c = self._counters[label] = {
-                    "joins": 0,
-                    "hot_keys_detected": 0,
-                    "rows_broadcast": 0,
-                    "rows_repartitioned": 0,
-                }
-            c["joins"] += 1
-            c["hot_keys_detected"] += int(hot_keys)
-            c["rows_broadcast"] += int(rows_broadcast)
-            c["rows_repartitioned"] += int(rows_repartitioned)
+                c = self._counters[label] = {}
+            # absent-key defaults: a label may already exist with only
+            # the multiway family (on_multiway) — the two families share
+            # the label map but keep disjoint keys
+            c["joins"] = c.get("joins", 0) + 1
+            c["hot_keys_detected"] = c.get("hot_keys_detected", 0) + int(hot_keys)
+            c["rows_broadcast"] = c.get("rows_broadcast", 0) + int(rows_broadcast)
+            c["rows_repartitioned"] = (
+                c.get("rows_repartitioned", 0) + int(rows_repartitioned)
+            )
+
+    def on_multiway(
+        self,
+        label: str,
+        dims: int,
+        rows_in: int,
+        rows_out: int,
+        intermediate_rows_avoided: int,
+    ) -> None:
+        """Fold one single-pass multiway join execution (ISSUE 17) into
+        the label's counters — the ``csvplus_join_multiway_*`` evidence
+        that the fused operator engaged and how large the cascade
+        intermediate it killed would have been.  One lock round per
+        join, same discipline as :meth:`on_join`.  Multiway labels get
+        their OWN counter dict (keys are disjoint from the routing
+        counters; the exporter reads both families with absent-key
+        defaults)."""
+        with self._lock:
+            c = self._counters.get(label)
+            if c is None:
+                c = self._counters[label] = {}
+            c["multiway_joins"] = c.get("multiway_joins", 0) + 1
+            c["multiway_dims"] = c.get("multiway_dims", 0) + int(dims)
+            c["multiway_rows_in"] = c.get("multiway_rows_in", 0) + int(rows_in)
+            c["multiway_rows_out"] = (
+                c.get("multiway_rows_out", 0) + int(rows_out)
+            )
+            c["multiway_intermediate_rows_avoided"] = (
+                c.get("multiway_intermediate_rows_avoided", 0)
+                + int(intermediate_rows_avoided)
+            )
 
     def build_sketch(self, label: str) -> SpaceSaving:
         """Get-or-create the label's build-side sketch."""

@@ -836,24 +836,51 @@ class TelemetryPlane:
         """The partitioned join's skew-routing split as counter
         families — how many heavy keys each index's planner detected
         and how the probe rows divided between the replicated broadcast
-        tier and the hash-repartition exchange.  Reads the process-
-        global registry, so pipeline joins that never touch a server
-        still show up on the scrape."""
+        tier and the hash-repartition exchange — plus the single-pass
+        multiway join's engagement counters (``csvplus_join_multiway_*``:
+        executions, fact rows in/out, and the cascade intermediate rows
+        the fusion avoided).  Reads the process-global registry, so
+        pipeline joins that never touch a server still show up on the
+        scrape.  A label may carry either counter family or both
+        (routing counters land per partitioned probe, multiway counters
+        per fused execution), so each family reads with absent-key
+        defaults."""
         out: List[Sample] = []
         for label, c in sorted(_joinskew().counters_snapshot().items()):
             tags = (("index", label),)
-            out.append(
-                Sample("csvplus_join_hot_keys_detected_total", "counter",
-                       tags, c["hot_keys_detected"])
-            )
-            out.append(
-                Sample("csvplus_join_rows_broadcast_total", "counter",
-                       tags, c["rows_broadcast"])
-            )
-            out.append(
-                Sample("csvplus_join_rows_repartitioned_total", "counter",
-                       tags, c["rows_repartitioned"])
-            )
+            if "hot_keys_detected" in c:
+                out.append(
+                    Sample("csvplus_join_hot_keys_detected_total", "counter",
+                           tags, c["hot_keys_detected"])
+                )
+                out.append(
+                    Sample("csvplus_join_rows_broadcast_total", "counter",
+                           tags, c["rows_broadcast"])
+                )
+                out.append(
+                    Sample("csvplus_join_rows_repartitioned_total", "counter",
+                           tags, c["rows_repartitioned"])
+                )
+            if "multiway_joins" in c:
+                out.append(
+                    Sample("csvplus_join_multiway_total", "counter",
+                           tags, c["multiway_joins"])
+                )
+                out.append(
+                    Sample("csvplus_join_multiway_rows_in_total", "counter",
+                           tags, c.get("multiway_rows_in", 0))
+                )
+                out.append(
+                    Sample("csvplus_join_multiway_rows_out_total", "counter",
+                           tags, c.get("multiway_rows_out", 0))
+                )
+                out.append(
+                    Sample(
+                        "csvplus_join_multiway_intermediate_rows_avoided_total",
+                        "counter", tags,
+                        c.get("multiway_intermediate_rows_avoided", 0),
+                    )
+                )
         return out
 
     def _flight_samples(self) -> List[Sample]:

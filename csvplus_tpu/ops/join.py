@@ -587,7 +587,8 @@ class DeviceIndex:
         return out
 
     def probe(
-        self, probe_cols: List[StringColumn], nrows: int
+        self, probe_cols: List[StringColumn], nrows: int,
+        part_info: "dict | None" = None,
     ) -> "Tuple[jax.Array, jax.Array] | Tuple[np.ndarray, np.ndarray]":
         """(lower, counts) per probe row.
 
@@ -599,6 +600,13 @@ class DeviceIndex:
 
         Fewer probe columns than key columns = a prefix probe matching the
         whole key range under the prefix.
+
+        *part_info* is the multiway join's shared partitioned-tier state
+        (``multiway_join`` threads ONE dict through every dimension's
+        probe): the exchange capacity settled while probing one dimension
+        seeds the next dimension's first attempt, and each dimension's
+        skew-routing evidence accumulates into the same dict — see
+        ``partitioned_probe_device``'s *info* contract.
         """
         from ..utils.observe import telemetry
 
@@ -649,7 +657,9 @@ class DeviceIndex:
                 # O(1) scalar sync per capacity attempt
                 return partitioned_probe_device(
                     qk_sh.mesh, qk, self._partitioned_for(qk_sh),
+                    capacity=(part_info or {}).get("capacity"),
                     label=",".join(self.key_columns),
+                    info=part_info,
                 )
 
             if self.direct_cum is not None:
@@ -699,7 +709,9 @@ class DeviceIndex:
             q_lo_m = jnp.where(ok, q_lo, jnp.int32(-1))
             return partitioned_probe_device_wide(
                 qk_sh.mesh, q_hi_m, q_lo_m, self._partitioned_for(qk_sh),
+                capacity=(part_info or {}).get("capacity"),
                 label=",".join(self.key_columns),
+                info=part_info,
             )
 
         range_size = 1 << range_shift
@@ -1003,6 +1015,324 @@ def _probe_stats(lower, counts):
     transfer decides the unique fast paths in :func:`join_tables`."""
     c = counts.astype(jnp.int32)
     return jnp.stack([jnp.sum(c), jnp.max(c) if c.shape[0] else jnp.int32(0)])
+
+
+# -- single-pass multiway join (ISSUE 17) ----------------------------------
+#
+# A run of cascaded binary joins over the same stream materializes every
+# intermediate table: at the 100M mesh tier the orders×customers
+# intermediate alone dominates peak RSS, and every fact row is packed,
+# exchanged and gathered once per cascade level.  ``multiway_join``
+# replaces the run with ONE pass: every dimension index is probed over
+# the ORIGINAL fact rows (a probe answer depends only on the key value,
+# so probing the fact row equals probing the intermediate row that
+# carries the same key), the cross-product fanout per fact row is
+# expanded by one jitted cumsum/scatter kernel, and each dimension's
+# build rows are addressed by mixed-radix decomposition of the
+# within-row output offset — dimension 0 outermost, exactly the
+# cascade's nested emission order.  Row order, column order and merge
+# semantics are bitwise-identical to folding ``join_tables`` left to
+# right; the rewriter only licenses the fusion when every later join's
+# key columns are provably PRESENT on the stream BEFORE the run (then
+# the cascade's per-level key checks and stream-wins merges cannot
+# observe the intermediate at all — see analysis/rewrite.py).
+
+
+def _fanout_products(counts):
+    """(int32 counts tuple, per-row cross-product fanout) — traceable."""
+    cs = tuple(c.astype(jnp.int32) for c in counts)
+    prod = cs[0]
+    for c in cs[1:]:
+        prod = prod * c
+    return cs, prod
+
+
+@register_kernel("join.multiway_stats")
+@jax.jit
+def _multiway_stats(counts):  # analysis: allow[JIT001] retrace is per join ARITY (number of build sides), not per data length
+    """(total matches, max fanout, cascade intermediate rows avoided) as
+    one stacked device triple — a single transfer decides the multiway
+    fast paths AND prices the intermediate the fusion killed."""
+    cs = tuple(c.astype(jnp.int32) for c in counts)
+    prod = cs[0]
+    inter = jnp.int32(0)
+    for c in cs[1:]:
+        inter = inter + jnp.sum(prod)
+        prod = prod * c
+    total = jnp.sum(prod)
+    maxp = jnp.max(prod) if prod.shape[0] else jnp.int32(0)
+    return jnp.stack([total, maxp, inter])
+
+
+@register_kernel("join.multiway_select")
+@_partial(jax.jit, static_argnames=("padded",))
+def _multiway_select_kernel(lowers, counts, padded: int):  # analysis: allow[JIT001] retrace is per join ARITY, not per data length
+    """Unique-but-partial fast path: every dimension matched <= once, so
+    the surviving fact rows compact by one pow2-padded flatnonzero and
+    each dimension's build row IS its lower bound — no expansion scan."""
+    _, prod = _fanout_products(counts)
+    sel = jnp.flatnonzero(prod > 0, size=padded, fill_value=0).astype(jnp.int32)
+    build = tuple(jnp.take(lo.astype(jnp.int32), sel, axis=0) for lo in lowers)
+    return sel, build
+
+
+@register_kernel("join.multiway_expand")
+@_partial(jax.jit, static_argnames=("padded_total",))
+def _multiway_expand_kernel(lowers, counts, padded_total: int):  # analysis: allow[JIT001] retrace is per join ARITY, not per data length
+    """Device cross-product fan-out with a static output size: the
+    per-row fanout (product of the dimensions' match counts) drives the
+    same exclusive-prefix-sum + scatter-markers + running-max inversion
+    as ``_expand_kernel``; the within-row offset then decomposes in
+    mixed radix (dimension 0 major, suffix products as the radices) into
+    one build-row offset per dimension — the cascade's nested emission
+    order without the cascade's intermediate."""
+    cs, prod = _fanout_products(counts)
+    ends = jnp.cumsum(prod)
+    starts = ends - prod
+    ids = jnp.arange(prod.shape[0], dtype=jnp.int32)
+    mark_pos = jnp.where(prod > 0, starts, padded_total)
+    seg = jnp.zeros(padded_total, dtype=jnp.int32)
+    seg = seg.at[mark_pos].max(ids, mode="drop")
+    probe_ids = jax.lax.cummax(seg)
+    out_pos = jnp.arange(padded_total, dtype=jnp.int32)
+    r = out_pos - jnp.take(starts, probe_ids, axis=0)
+    # suffix products: sufs[d] = prod of counts of dimensions AFTER d
+    suffix = jnp.ones(prod.shape[0], dtype=jnp.int32)
+    sufs = []
+    for c in reversed(cs):
+        sufs.append(suffix)
+        suffix = suffix * c
+    sufs.reverse()
+    build_ids = []
+    for d, (lo, c, su) in enumerate(zip(lowers, cs, sufs)):
+        o = r // jnp.take(jnp.maximum(su, 1), probe_ids, axis=0)
+        if d > 0:  # dimension 0 is the major digit: no wrap needed
+            o = o % jnp.take(jnp.maximum(c, 1), probe_ids, axis=0)
+        build_ids.append(
+            jnp.take(lo.astype(jnp.int32), probe_ids, axis=0) + o
+        )
+    return probe_ids, tuple(build_ids)
+
+
+def _multiway_expand_host(lowers, counts):
+    """Host cross-product fan-out (numpy probe answers): same mixed-radix
+    decomposition as the device kernel.  Returns
+    (probe_ids, build_ids per dim, total, intermediate rows avoided)."""
+    cs = [np.asarray(c).astype(np.int64) for c in counts]
+    prod = cs[0].copy()
+    inter = 0
+    for c in cs[1:]:
+        inter += int(prod.sum())
+        prod *= c
+    total = int(prod.sum())
+    probe_ids = np.repeat(np.arange(prod.shape[0], dtype=np.int64), prod)
+    ends = np.cumsum(prod)
+    r = np.arange(total, dtype=np.int64) - np.repeat(ends - prod, prod)
+    suffix = np.ones_like(prod)
+    sufs = []
+    for c in reversed(cs):
+        sufs.append(suffix)
+        suffix = suffix * c
+    sufs.reverse()
+    build_ids = []
+    for d, (lo, c, su) in enumerate(zip(lowers, cs, sufs)):
+        o = r // np.maximum(su, 1)[probe_ids]
+        if d > 0:
+            o = o % np.maximum(c, 1)[probe_ids]
+        build_ids.append(np.asarray(lo).astype(np.int64)[probe_ids] + o)
+    return probe_ids, tuple(build_ids), total, inter
+
+
+@register_kernel("join.gather_multiway")
+@jax.jit
+def _gather_multiway(build_codes, build_ids):  # analysis: allow[JIT001] — arity fixed per pipeline shape
+    """All build sides' row-materializing gathers in ONE jit call (the
+    unique-identity path: stream columns pass through untouched)."""
+    out = []
+    for codes, ids in zip(build_codes, build_ids):
+        idx = jnp.asarray(ids, dtype=jnp.int32)
+        out.append(tuple(jnp.take(c, idx, axis=0) for c in codes))
+    return tuple(out)
+
+
+@register_kernel("join.gather_multiway_both")
+@jax.jit
+def _gather_multiway_both(build_codes, stream_codes, build_ids, probe_ids):  # analysis: allow[JIT001] — arity fixed per pipeline shape
+    """Every side's gathers — N build sides + the stream — fused into
+    one executable, the multiway form of ``_gather_both_sides``."""
+    out_b = []
+    for codes, ids in zip(build_codes, build_ids):
+        idx = jnp.asarray(ids, dtype=jnp.int32)
+        out_b.append(tuple(jnp.take(c, idx, axis=0) for c in codes))
+    p_idx = jnp.asarray(probe_ids, dtype=jnp.int32)
+    return (
+        tuple(out_b),
+        tuple(jnp.take(c, p_idx, axis=0) for c in stream_codes),
+    )
+
+
+def multiway_join(
+    stream: DeviceTable,
+    specs: "Sequence[Tuple[DeviceIndex, Sequence[str]]]",
+) -> DeviceTable:
+    """stream ⋈ index_1 ⋈ ... ⋈ index_k in ONE pass over the stream —
+    bitwise-identical (row order, column order, values, errors) to
+    ``join_tables`` applied left to right, without materializing any
+    intermediate table.  *specs* lists the cascade's (DeviceIndex, key
+    columns) pairs in cascade order."""
+    from ..columnar.table import merge_with_fallback
+    from ..obs.joinskew import joinskew
+    from ..utils.observe import telemetry
+
+    if len(specs) == 1:  # degenerate run: exactly the binary join
+        return join_tables(stream, specs[0][0], specs[0][1])
+
+    if stream.nrows == 0:
+        # per-row key validation never fires on an empty stream — fold
+        # the cascade's empty early-out per level so column order and
+        # kinds match the cascade exactly
+        out = stream
+        for dev_index, _cols in specs:
+            empty = np.empty(0, dtype=np.int64)
+            out_cols = {
+                name: col.gather(empty)
+                for name, col in {
+                    **dev_index.table.columns, **out.columns
+                }.items()
+            }
+            out = DeviceTable(out_cols, 0, stream.device)
+        return out
+
+    # one pass: every dimension's keys validate and probe over the
+    # ORIGINAL stream rows.  The fusion license (rewrite.py) guarantees
+    # later dimensions' keys are PRESENT before the run, so validating
+    # them here raises exactly what the cascade's per-level checks would.
+    part_info: dict = {}
+    answers = []
+    for dev_index, cols in specs:
+        probe_cols = _checked_probe_cols(stream, cols)
+        answers.append(
+            dev_index.probe(probe_cols, stream.nrows, part_info=part_info)
+        )
+    lowers = tuple(lo for lo, _ in answers)
+    counts = tuple(ct for _, ct in answers)
+
+    probe_ids = None
+    inter = 0
+    with telemetry.stage("join:expand", stream.nrows) as _exp:
+        _exp["dims"] = len(specs)
+        if all(isinstance(lo, jax.Array) for lo in lowers):
+            # (total, max fanout, intermediate rows avoided) in ONE
+            # host transfer; unique dimensions skip the expansion scan
+            total, maxp, inter = (
+                int(v) for v in np.asarray(_multiway_stats(counts))
+            )
+            if maxp <= 1 and total == stream.nrows:
+                # every stream row matched exactly once in EVERY
+                # dimension: stream columns pass through ungathered,
+                # each dimension's build rows are its lower bounds
+                build_ids = lowers
+                _exp["path"] = "multiway-unique-identity"
+            elif maxp <= 1:
+                padded = 1 << max(total - 1, 0).bit_length() if total else 1
+                probe_ids, build_ids = _multiway_select_kernel(
+                    lowers, counts, padded
+                )
+                probe_ids = probe_ids[:total]
+                build_ids = tuple(b[:total] for b in build_ids)
+                _exp["path"] = "multiway-unique-partial"
+            else:
+                padded = 1 << max(total - 1, 0).bit_length() if total else 1
+                probe_ids, build_ids = _multiway_expand_kernel(
+                    lowers, counts, padded
+                )
+                probe_ids = probe_ids[:total]
+                build_ids = tuple(b[:total] for b in build_ids)
+                _exp["path"] = "multiway-fan-out"
+        else:  # a host-answering tier: expand in numpy
+            probe_ids, build_ids, total, inter = _multiway_expand_host(
+                lowers, counts
+            )
+            _exp["path"] = "multiway-host-expand"
+        _exp["rows_out"] = total
+        telemetry.barrier((probe_ids,) + tuple(build_ids))
+
+    build_names = [list(di.table.columns) for di, _ in specs]
+    build_codes = tuple(
+        tuple(
+            _aligned_codes(di, n, di.table.columns[n].storage, bid)
+            for n in names
+        )
+        for (di, _), names, bid in zip(specs, build_names, build_ids)
+    )
+    stream_names = list(stream.columns)
+    stream_codes = tuple(stream.columns[n].storage for n in stream_names)
+    flat_build = tuple(c for side in build_codes for c in side)
+
+    with telemetry.stage("join:merge", stream.nrows) as _mrg:
+        if probe_ids is None:
+            if same_placement(flat_build + tuple(build_ids)):
+                g_build = _gather_multiway(build_codes, build_ids)
+            else:
+                g_build = tuple(
+                    tuple(
+                        jnp.take(c, jnp.asarray(b, dtype=jnp.int32), axis=0)
+                        for c in side
+                    )
+                    for side, b in zip(build_codes, build_ids)
+                )
+            g_stream = None
+            n_out = stream.nrows
+        elif same_placement(flat_build + stream_codes):
+            g_build, g_stream = _gather_multiway_both(
+                build_codes, stream_codes, build_ids, probe_ids
+            )
+            n_out = total
+        else:
+            # mixed placements: eager per-column takes, each free to
+            # resolve its own placement (the host-expand tier lands here)
+            g_build = tuple(
+                tuple(
+                    jnp.take(c, jnp.asarray(b, dtype=jnp.int32), axis=0)
+                    for c in side
+                )
+                for side, b in zip(build_codes, build_ids)
+            )
+            p_idx = jnp.asarray(probe_ids, dtype=jnp.int32)
+            g_stream = tuple(
+                jnp.take(c, p_idx, axis=0) for c in stream_codes
+            )
+            n_out = total
+
+        # fold the cascade's merge left to right: level d inserts build
+        # side d's columns first, then overlays the running result with
+        # stream-wins / absent-cell-fallback semantics — identical
+        # column order and values to the cascade (elementwise merges
+        # commute with the row gathers already applied)
+        if g_stream is None:
+            cur = dict(stream.columns)
+        else:
+            cur = {
+                name: stream.columns[name].with_storage(g)
+                for name, g in zip(stream_names, g_stream)
+            }
+        for (di, _), names, gathered in zip(specs, build_names, g_build):
+            new = {}
+            for name, g in zip(names, gathered):
+                new[name] = di.table.columns[name].with_storage(g)
+            for name, col in cur.items():
+                if name in new:
+                    col = merge_with_fallback(col, new[name])
+                new[name] = col
+            cur = new
+        _mrg["rows_out"] = n_out
+        telemetry.barrier(tuple(c.storage for c in cur.values()))
+
+    joinskew.on_multiway(
+        "+".join(",".join(di.key_columns) for di, _ in specs),
+        len(specs), stream.nrows, n_out, inter,
+    )
+    return DeviceTable(cur, n_out, stream.device)
 
 
 def except_mask(

@@ -811,9 +811,29 @@ def _retry_probe_device(mesh: Mesh, m: int, capacity: "int | None", launch):
             retries += 1
 
 
+def _note_part_info(info, capacity, hot, rows_broadcast) -> None:
+    """Accumulate one partitioned probe's outcome into the multiway
+    join's shared *info* dict (the sharded-multiway contract, ISSUE 17):
+    ``capacity`` is the max settled exchange capacity so far — the next
+    dimension's probe seeds its FIRST attempt with it, so similar
+    fanouts pay at most one geometric retry round across ALL dimensions
+    instead of one per dimension — and the hot-routing tallies sum over
+    dimensions (hot keys of EITHER dimension ride the broadcast tier;
+    the tail crosses the exchange once per dimension over the original
+    fact rows, never over a materialized intermediate)."""
+    if info is None:
+        return
+    info["capacity"] = max(int(capacity), int(info.get("capacity") or 0))
+    info["dims"] = info.get("dims", 0) + 1
+    info["hot_keys"] = info.get("hot_keys", 0) + (
+        int(hot.size) if hot is not None else 0
+    )
+    info["rows_broadcast"] = info.get("rows_broadcast", 0) + int(rows_broadcast)
+
+
 def partitioned_probe_device(
     mesh: Mesh, qk: jax.Array, prepared, capacity: "int | None" = None,
-    label: "str | None" = None,
+    label: "str | None" = None, info: "dict | None" = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Device-resident narrow-key partitioned probe: *qk* (int32, -1 =
     invalid) stays on device end to end; answers come back as device
@@ -822,7 +842,9 @@ def partitioned_probe_device(
     Host syncs per call: one bounded hot-key sample + one O(1) scalar
     sync per capacity attempt (VERDICT round-2 weak #3).  *label*
     names the probed index in the skew-routing evidence
-    (``csvplus_join_*`` counters, ``join:skew`` stage row)."""
+    (``csvplus_join_*`` counters, ``join:skew`` stage row).  *info*
+    accumulates this probe's settled capacity and hot-routing split for
+    the multiway join's cross-dimension sharing (:func:`_note_part_info`)."""
     n_shards = mesh.devices.size
     uniq, lower, count, splits = prepared
     m = int(qk.shape[0])
@@ -856,6 +878,7 @@ def partitioned_probe_device(
             label, m, int(hot.size), rows_broadcast, cap_used,
             skew_threshold(n_shards),
         )
+    _note_part_info(info, cap_used, hot, rows_broadcast)
     return out
 
 
@@ -866,6 +889,7 @@ def partitioned_probe_device_wide(
     prepared,
     capacity: "int | None" = None,
     label: "str | None" = None,
+    info: "dict | None" = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Device-resident wide-key (62-bit dual-lane) partitioned probe.
     Invalid probes carry (-1, -1) lanes."""
@@ -903,6 +927,7 @@ def partitioned_probe_device_wide(
             label, m, int(hot.size), rows_broadcast, cap_used,
             skew_threshold(n_shards),
         )
+    _note_part_info(info, cap_used, hot, rows_broadcast)
     return out
 
 

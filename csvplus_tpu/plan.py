@@ -177,6 +177,28 @@ class Except(PlanNode):
     columns: Tuple[str, ...]
 
 
+@dataclass(frozen=True)
+class MultiwayJoin(PlanNode):
+    """Fused physical operator for a run of consecutive :class:`Join`
+    stages: ONE pass over the stream resolves bounds against every build
+    index and emits the cross-product fanout directly — no materialized
+    intermediate table between the joins.  ``joins`` holds the original
+    cascade's ``(index, key columns)`` pairs in cascade order, so the
+    result is bitwise-identical (row order, column order, merge
+    semantics) to applying the binary joins in sequence.  Never built by
+    user combinators: only the rewriter emits it, behind a cost-model
+    choice and a provenance license (every later join's key columns must
+    be PRESENT on the stream side, proving the cascade could not have
+    errored in between)."""
+
+    child: PlanNode
+    joins: Tuple[Tuple[Any, Tuple[str, ...]], ...]
+
+    def __repr__(self) -> str:
+        keys = [list(cols) for _, cols in self.joins]
+        return f"MultiwayJoin({keys}) <- {self.child!r}"
+
+
 def _is_symbolic(obj: Any) -> bool:
     """A stage argument is symbolic when it opts in via ``__plan_expr__``.
 

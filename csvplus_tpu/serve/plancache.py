@@ -115,6 +115,23 @@ def _node_sig(node: P.PlanNode) -> Tuple:
                 _schema_sig(build.table),
             )
         return (t, tuple(node.columns), tuple(impl.columns), build_sig)
+    if isinstance(node, P.MultiwayJoin):
+        # Never submitted by user combinators (only the rewriter emits
+        # it), but a complete signature keeps the key total if one ever
+        # arrives: the per-dimension (keys, index cols, build schema)
+        # tuples in cascade order.
+        dims = []
+        for index, columns in node.joins:
+            impl = getattr(index, "_impl", index)
+            build = getattr(impl, "dev", None)
+            build_sig = None
+            if build is not None:
+                build_sig = (
+                    tuple(build.key_columns),
+                    _schema_sig(build.table),
+                )
+            dims.append((tuple(columns), tuple(impl.columns), build_sig))
+        return (t, tuple(dims))
     # future node kinds degrade to type-only — a coarser key can only
     # cause false misses, never false hits across different op types
     return (t,)
@@ -188,6 +205,10 @@ class PlanCache:
         self.lowered = 0  # shapes verified+admitted (ticks only on miss)
         self.optimized = 0  # admitted shapes that carry a rewrite recipe
         self.optimize_failed = 0  # rewriter raised; shape runs unrewritten
+        # ISSUE 17 attribution: which optimized shapes carry a
+        # cost-chosen join-order permutation / a fused MultiwayJoin.
+        self.reordered = 0
+        self.fused = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -239,6 +260,10 @@ class PlanCache:
             self.lowered += 1
             if recipe is not None:
                 self.optimized += 1
+                if getattr(recipe, "join_order", ()):
+                    self.reordered += 1
+                if any(s[0] == "fuse_joins" for s in recipe.steps):
+                    self.fused += 1
             self._entries[key] = exe
             while len(self._entries) > self.size:
                 self._entries.popitem(last=False)
@@ -264,5 +289,7 @@ class PlanCache:
                 "lowered": self.lowered,
                 "optimized": self.optimized,
                 "optimize_failed": self.optimize_failed,
+                "reordered": self.reordered,
+                "fused": self.fused,
                 "hit_rate": round(self.hits / total, 4) if total else None,
             }

@@ -67,6 +67,9 @@ def main() -> None:
     if "--skew" in sys.argv:
         _skew_main(n_orders)
         return
+    if "--multiway" in sys.argv:
+        _multiway_main(n_orders)
+        return
     from northstar import DATA_DIR, generate  # same generator/cache
 
     opath = generate(n_orders)
@@ -473,6 +476,260 @@ def _skew_main(n_orders: int) -> None:
                     " detection + broadcast tier for heavy keys + shrunken"
                     " exchange capacity for the tail; parity is FULL-result"
                     " positional per-column checksums, not a prefix"
+                ),
+            }
+        )
+    )
+
+
+def _multiway_main(n_orders: int) -> None:
+    """The ``--multiway`` tier (ISSUE 17): the cascaded 3-way join vs
+    the single-pass multiway operator over the SAME Zipf-skewed bytes,
+    both legs in ONE process.
+
+    Same measurement discipline as the skew tier — cold pass, warm
+    best-of-3 with telemetry off and zero recompiles asserted, then one
+    instrumented pass for the per-stage table — with two additions:
+
+    * both legs execute through :class:`PlanCache` (the production
+      serving path), differing ONLY in ``CSVPLUS_MULTIWAY``: the
+      cascaded leg admits with the fuse pass off (optimizer otherwise
+      on, skew tier on), the multiway leg must actually FUSE
+      (``stats()["fused"] >= 1`` is asserted, not assumed);
+    * each leg runs under its own fresh :class:`MemoryWatermark`
+      sampler (VmHWM is process-lifetime and cannot be reset), with a
+      gc + host-staging trim between legs, so the artifact carries a
+      per-leg RSS peak — the number the tentpole's
+      "kill the intermediate" claim is judged on.
+
+    Parity is FULL-result positional per-column checksums between the
+    legs (hard assert); the RSS-below and throughput-at-least targets
+    are recorded as booleans plus a per-stage ``obs diff`` attribution
+    table (which stages the fusion removed or shrank).
+    """
+    # same knobs as the skew tier: partition tier must engage on the
+    # 1.5M-key customer index, detection sized for the s=1.1 tail
+    os.environ.setdefault("CSVPLUS_PARTITION_MIN_KEYS", "1000000")
+    os.environ.setdefault("CSVPLUS_JOIN_SKEW_SAMPLE", "16384")
+    os.environ.setdefault("CSVPLUS_JOIN_SKEW_THRESHOLD", "0.002")
+    os.environ["CSVPLUS_JOIN_SKEW"] = "1"  # BOTH legs skew-aware
+    n_cust = int(os.environ.get("CSVPLUS_BENCH_MESH_ZIPF_CUSTOMERS", 1_500_000))
+    zipf_s = float(os.environ.get("CSVPLUS_BENCH_MESH_ZIPF_S", 1.1))
+
+    import gc
+
+    import bench  # repo root is on sys.path (header insert)
+
+    opath, cpath = bench.zipf_fact_table(n_orders, n_cust, s=zipf_s)
+    print(
+        f"zipf orders file: {opath} ({os.path.getsize(opath) / 1e9:.2f} GB),"
+        f" s={zipf_s}, {n_cust:,} customers",
+        file=sys.stderr,
+    )
+
+    import jax
+
+    from csvplus_tpu import FromFile
+    from csvplus_tpu.columnar.ingest import _trim_host_staging
+    from csvplus_tpu.native.scanner import _ingest_workers
+    from csvplus_tpu.obs.diff import diff_stage_tables
+    from csvplus_tpu.obs.joinskew import joinskew
+    from csvplus_tpu.obs.memory import MemoryWatermark, host_header
+    from csvplus_tpu.obs.recompile import RecompileWatch
+    from csvplus_tpu.serve.plancache import PlanCache
+    from csvplus_tpu.utils.checksum import checksum_device_table
+    from csvplus_tpu.utils.observe import telemetry
+
+    assert len(jax.devices()) >= N_SHARDS, jax.devices()
+
+    t0 = time.perf_counter()
+    orders = FromFile(opath).OnDevice(shards=N_SHARDS)
+    orders.plan.table.sync()
+    t_ingest = time.perf_counter() - t0
+    table = orders.plan.table
+    assert getattr(table, "_pre_sharded", False), "sharded ingest did not engage"
+    print(
+        f"ingest (sharded): {n_orders / t_ingest:,.0f} rows/s"
+        f" ({t_ingest:,.1f}s), rss {_rss_mb():,.0f} MB",
+        file=sys.stderr,
+    )
+
+    from northstar import DATA_DIR  # products.csv lives in the same cache
+
+    t0 = time.perf_counter()
+    cust_idx = FromFile(cpath).OnDevice().UniqueIndexOn("id")
+    prod_idx = (
+        FromFile(os.path.join(DATA_DIR, "products.csv"))
+        .OnDevice()
+        .UniqueIndexOn("prod_id")
+    )
+    t_index = time.perf_counter() - t0
+    print(f"index build: {t_index:,.1f}s", file=sys.stderr)
+
+    # the SAME submitted plan for both legs: Scan -> Join(cust) ->
+    # Join(prod); only the admission-time CSVPLUS_MULTIWAY flag differs
+    plan = orders.Join(cust_idx, "cust_id").Join(prod_idx).plan
+    joinskew.reset()
+
+    legs = {}
+    stage_tables = {}
+    checksums = {}
+    recipes = {}
+    for mode, flag in (("cascaded", "0"), ("multiway", "1")):
+        os.environ["CSVPLUS_MULTIWAY"] = flag
+        cache = PlanCache()
+        # level the memory baseline before each leg's sampler starts:
+        # drop the previous leg's released buffers and return freed
+        # host staging to the OS, so each watermark measures its own
+        # leg's working set, not the other's allocator retention
+        gc.collect()
+        _trim_host_staging()
+        wm = MemoryWatermark(interval_s=0.02).start()
+        t0 = time.perf_counter()
+        result = cache.execute(plan)  # cold: verify+optimize+compile
+        t_cold = time.perf_counter() - t0
+        assert result.nrows == n_orders, result.nrows
+        cols = sorted(result.columns)
+        checksums[mode] = checksum_device_table(result, cols, positional=True)
+        result = None  # release before the warm passes (see main())
+        warm_times = []
+        with RecompileWatch() as recompiles:
+            for _ in range(3):
+                t0 = time.perf_counter()
+                r = cache.execute(plan)
+                warm_times.append(time.perf_counter() - t0)
+                r = None
+        recompiles.assert_zero(f"mesh warm multiway-tier joins ({mode})")
+        t_warm = min(warm_times)
+        with telemetry.collect() as jrecords:
+            cache.execute(plan)
+            join_records = list(jrecords)
+        telemetry.records[:] = join_records
+        stage_tables[mode] = telemetry.to_json()["stage_table"]
+        telemetry.reset()
+        wm.stop()
+        stats = cache.stats()
+        if mode == "multiway":
+            assert stats["fused"] >= 1, f"multiway leg did not fuse: {stats}"
+        else:
+            assert stats["fused"] == 0, f"cascaded leg fused: {stats}"
+        recipe = cache.executable_for(plan).recipe  # warm hit
+        recipes[mode] = {
+            "steps": [
+                [s[0]]
+                + [list(a) if isinstance(a, (list, tuple)) else a for a in s[1:]]
+                for s in (recipe.steps if recipe is not None else ())
+            ],
+            "join_order": list(getattr(recipe, "join_order", ()) or ()),
+        }
+        legs[mode] = {
+            "cold_sec": round(t_cold, 2),
+            "warm_sec": round(t_warm, 2),
+            "warm_passes_sec": [round(t, 2) for t in warm_times],
+            "rows_per_sec_warm": round(n_orders / t_warm, 1),
+            "recompiles_warm": recompiles.delta(),
+            "peak_host_rss_mb": round(wm.rss_peak_mb, 1),
+            "rss_start_mb": wm.attrs()["rss_start_mb"],
+            "plancache_fused": stats["fused"],
+        }
+        print(
+            f"3-way join [{mode}]: warm best-of-3"
+            f" {n_orders / t_warm:,.0f} rows/s ({t_warm:,.2f}s; passes"
+            f" {', '.join(f'{t:,.2f}s' for t in warm_times)});"
+            f" leg rss peak {wm.rss_peak_mb:,.0f} MB"
+            f" (start {legs[mode]['rss_start_mb']:,.0f} MB)",
+            file=sys.stderr,
+        )
+
+    assert checksums["multiway"] == checksums["cascaded"], (
+        "bitwise parity broke: multiway checksums differ from the"
+        " CSVPLUS_MULTIWAY=0 cascade over the same bytes"
+    )
+    snap = joinskew.counters_snapshot()
+    # multiway engagement counters are labelled by the fused dims' key
+    # columns joined with '+'; routing counters by the customer index's
+    # key column ("id")
+    mw_counters = snap.get("id+prod_id")
+    assert mw_counters and mw_counters.get("multiway_joins", 0) >= 5, (
+        f"multiway counters never landed: {snap}"
+    )
+    skew_counters = snap.get("id")
+
+    rss_below = (
+        legs["multiway"]["peak_host_rss_mb"] < legs["cascaded"]["peak_host_rss_mb"]
+    )
+    thr_at_least = (
+        legs["multiway"]["rows_per_sec_warm"] >= legs["cascaded"]["rows_per_sec_warm"]
+    )
+    speedup = legs["cascaded"]["warm_sec"] / legs["multiway"]["warm_sec"]
+    # per-stage obs-diff attribution: which stages the fusion removed
+    # (the interior probe/gather/merge) and which it grew (expand)
+    stage_diff = diff_stage_tables(
+        stage_tables["cascaded"], stage_tables["multiway"]
+    )
+    for check, ok in (("rss below cascaded", rss_below),
+                      ("throughput >= cascaded", thr_at_least)):
+        if not ok:
+            print(f"WARNING: multiway target missed: {check}", file=sys.stderr)
+    print(
+        f"parity: full positional checksums identical across operators;"
+        f" multiway {speedup:,.2f}x vs cascaded, rss"
+        f" {legs['multiway']['peak_host_rss_mb']:,.0f} vs"
+        f" {legs['cascaded']['peak_host_rss_mb']:,.0f} MB;"
+        f" counters: {mw_counters}",
+        file=sys.stderr,
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "northstar_mesh_threeway_join_multiway",
+                "rows": n_orders,
+                "n_shards": N_SHARDS,
+                "n_customers": n_cust,
+                "zipf_s": zipf_s,
+                "ingest_workers": _ingest_workers(),
+                "backend": jax.default_backend(),
+                **host_header(),
+                "env_overrides": {
+                    k: os.environ[k]
+                    for k in (
+                        "CSVPLUS_PARTITION_MIN_KEYS",
+                        "CSVPLUS_JOIN_SKEW_SAMPLE",
+                        "CSVPLUS_JOIN_SKEW_THRESHOLD",
+                        "CSVPLUS_JOIN_SKEW",
+                        "CSVPLUS_STREAM_MIN_BYTES",
+                    )
+                },
+                "ingest_rows_per_sec": round(n_orders / t_ingest, 1),
+                "join_rows_per_sec_warm_multiway": legs["multiway"][
+                    "rows_per_sec_warm"
+                ],
+                "join_rows_per_sec_warm_cascaded": legs["cascaded"][
+                    "rows_per_sec_warm"
+                ],
+                "multiway_speedup": round(speedup, 2),
+                "rss_below_cascaded": rss_below,
+                "throughput_ge_cascaded": thr_at_least,
+                "legs": legs,
+                "recipes": recipes,
+                "multiway_counters": mw_counters,
+                "skew_counters": skew_counters,
+                "parity_bitwise": True,
+                "full_result_checksums": checksums["multiway"],
+                "peak_host_rss_mb": round(_rss_mb(), 1),
+                "stage_table_cascaded": stage_tables["cascaded"],
+                "stage_table_multiway": stage_tables["multiway"],
+                "stage_diff_cascaded_vs_multiway": stage_diff,
+                "note": (
+                    "both legs in ONE process over identical bytes, both"
+                    " through PlanCache with the skew tier on; cascaded ="
+                    " CSVPLUS_MULTIWAY=0 (Join->Join with a materialized"
+                    " intermediate), multiway = the rewriter's cost-chosen"
+                    " fused single-pass operator; per-leg RSS peaks are"
+                    " fresh-sampler watermarks (VmHWM is process-lifetime),"
+                    " cascaded leg runs first; parity is FULL-result"
+                    " positional per-column checksums"
                 ),
             }
         )

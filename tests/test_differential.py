@@ -651,3 +651,87 @@ def test_storage_mesh_sharded_append_parity(tmp_path, monkeypatch):
     # probes answer identically post-compaction
     assert [r["c"] for r in mi.find_rows("o210")] == ["210"]
     assert mi.find_rows("o999") == []
+
+
+def _multiway_differential(dim1_rows, dim2_rows, stream_rows):
+    """3-way join chain served through the plan cache with the multiway
+    fuse enabled vs the CSVPLUS_MULTIWAY=0 cascade (bitwise) vs the host
+    executor (row-identical) — ISSUE 17's parity contract."""
+    import os
+
+    from csvplus_tpu.serve import PlanCache
+    from csvplus_tpu.utils.checksum import checksum_device_table
+
+    idx1 = TakeRows(dim1_rows).index_on("a")
+    idx2 = TakeRows(dim2_rows).index_on("a")
+    host = TakeRows(stream_rows).join(idx1, "a").join(idx2, "a").to_rows()
+    idx1.on_device("cpu")
+    idx2.on_device("cpu")
+    plan = (
+        source_from_table(DeviceTable.from_rows(stream_rows, device="cpu"))
+        .join(idx1, "a")
+        .join(idx2, "a")
+        .plan
+    )
+    prev = os.environ.get("CSVPLUS_MULTIWAY")
+    try:
+        os.environ["CSVPLUS_MULTIWAY"] = "0"
+        cascade = PlanCache(size=4).execute(plan)
+        os.environ.pop("CSVPLUS_MULTIWAY")
+        fused = PlanCache(size=4).execute(plan)
+    finally:
+        if prev is None:
+            os.environ.pop("CSVPLUS_MULTIWAY", None)
+        else:
+            os.environ["CSVPLUS_MULTIWAY"] = prev
+    assert fused.nrows == cascade.nrows == len(host)
+    assert list(fused.columns) == list(cascade.columns)
+    assert checksum_device_table(fused, positional=True) == (
+        checksum_device_table(cascade, positional=True)
+    )
+    assert fused.to_rows() == host
+
+
+def test_multiway_fuse_fixed_examples_match_cascade_and_host():
+    """Deterministic multiway differentials (run even without
+    hypothesis): duplicate build keys in both dims (cross-product
+    fanout), misses in the second dim, stream-wins column collisions,
+    and the empty stream."""
+    d1 = [Row({"a": "x", "d": "d0"}), Row({"a": "x", "d": "d1"}),
+          Row({"a": "y", "d": "d2"})]
+    d2 = [Row({"a": "x", "e": "e0"}), Row({"a": "y", "e": "e1"}),
+          Row({"a": "y", "e": "e2"})]
+    stream = [Row({"a": "x", "b": "s0"}), Row({"a": "y", "b": "s1"}),
+              Row({"a": "zz", "b": "s2"}), Row({"a": "x", "b": "s3"})]
+    _multiway_differential(d1, d2, stream)
+    # stream-wins collisions: both dims and the stream carry "b"/"c"
+    d1c = [Row({"a": "x", "b": "B1", "c": "C1"}), Row({"a": "y", "b": "B2"})]
+    d2c = [Row({"a": "x", "c": "C2"}), Row({"a": "zz", "c": "C3"})]
+    streamc = [Row({"a": "x", "c": "sc"}), Row({"a": "x", "b": "sb"}),
+               Row({"a": "y", "b": "sb2", "c": "sc2"})]
+    _multiway_differential(d1c, d2c, streamc)
+    # every second-dim probe misses; then the empty stream
+    _multiway_differential(d1, [Row({"a": "nope", "e": "e9"})], stream)
+    _multiway_differential(d1, d2, [])
+
+
+@given(
+    tables(min_rows=1, max_rows=16),
+    tables(min_rows=1, max_rows=16),
+    tables(min_rows=0, max_rows=20),
+)
+def test_random_multiway_fuse_matches_cascade_and_host(
+    dim1_rows, dim2_rows, stream_rows
+):
+    """ISSUE 17 differential: a 3-way join chain served through the
+    plan cache with the multiway fuse enabled is bitwise the
+    CSVPLUS_MULTIWAY=0 cascade AND row-identical to the host executor —
+    duplicate build keys (cross-product fanout), misses, and stream-wins
+    column collisions included."""
+    if not all("a" in r for r in dim1_rows):
+        return
+    if not all("a" in r for r in dim2_rows):
+        return
+    if not all("a" in r for r in stream_rows):
+        return
+    _multiway_differential(dim1_rows, dim2_rows, stream_rows)

@@ -327,3 +327,111 @@ def test_rewritten_plan_reverified_same_verdict():
     orig = sorted(_chain_ops(plan))
     new = sorted(_chain_ops(result.root))
     assert [op for op in new if op != "DropCols"] == orig
+
+
+# -- ISSUE 17: ranked join orders executed + the multiway fuse ---------
+
+
+def _cat_dim(n=8):
+    t = DeviceTable.from_pylists(
+        {"cat": [f"k{i}" for i in range(n)],
+         "label": [f"L{i}" for i in range(n)]},
+        device="cpu",
+    )
+    return cp.take(t).index_on("cat").sync()
+
+
+def _cat_anti(n=2):
+    t = DeviceTable.from_pylists(
+        {"cat": [f"k{i}" for i in range(n)],
+         "tag": ["t"] * n},
+        device="cpu",
+    )
+    return cp.take(t).index_on("cat").sync()
+
+
+def test_join_order_executes_ranked_permutation_bitwise():
+    """The cost domain's best PROVABLE ranked order (anti-join first —
+    it halves the probe run's input) is EXECUTED, recorded on the recipe
+    as ``join_order`` in original chain slots, and counted by the
+    serving cache — all bitwise-differential against the submitted
+    order."""
+    plan = P.Except(
+        P.Join(P.Scan(_fact()), _dim(), ("id",)),
+        _cat_anti(),
+        ("cat",),
+    )
+    result = optimize_plan(plan)
+    assert any(r.startswith("join-order") for r in result.applied)
+    assert result.recipe.join_order == (2, 1)
+    assert _chain_ops(result.root) == ["Scan", "Except", "Join"]
+    _bitwise_equal(_run(plan), _run(result.root))
+    cache = PlanCache(size=8)
+    got = cache.execute(plan)
+    assert cache.stats()["reordered"] == 1
+    _bitwise_equal(got, _run(plan))
+
+
+def test_multiway_fuse_bitwise_and_counted():
+    """A 2-join probe run collapses into ONE MultiwayJoin when the cost
+    model prices the fused operator cheaper: the recipe carries the
+    ``fuse_joins`` step plus the later dimension's key obligation, the
+    fused execution is bitwise the cascade's, and the serving cache
+    counts the fuse."""
+    plan = P.Join(
+        P.Join(P.Scan(_fact()), _dim(), ("id",)),
+        _cat_dim(),
+        ("cat",),
+    )
+    result = optimize_plan(plan)
+    assert any(r.startswith("multiway-fuse") for r in result.applied)
+    assert ("fuse_joins", 1, 2) in result.recipe.steps
+    assert _chain_ops(result.root) == ["Scan", "MultiwayJoin"]
+    # the fused pass probes the ORIGINAL stream: the later dimension's
+    # key column becomes a leaf presence obligation
+    assert "cat" in result.recipe.require_present
+    _bitwise_equal(_run(plan), _run(result.root))
+    cache = PlanCache(size=8)
+    got = cache.execute(plan)
+    assert cache.stats()["fused"] == 1
+    _bitwise_equal(got, _run(plan))
+
+
+def test_multiway_disabled_hatch(monkeypatch):
+    """CSVPLUS_MULTIWAY=0: the same fusible chain keeps its cascade
+    shape (no fuse step, both Joins live) and answers identically."""
+    monkeypatch.setenv("CSVPLUS_MULTIWAY", "0")
+    plan = P.Join(
+        P.Join(P.Scan(_fact()), _dim(), ("id",)),
+        _cat_dim(),
+        ("cat",),
+    )
+    result = optimize_plan(plan)
+    assert not any(r.startswith("multiway-fuse") for r in result.applied)
+    steps = result.recipe.steps if result.recipe else ()
+    assert not any(s[0] == "fuse_joins" for s in steps)
+    assert _chain_ops(result.root).count("Join") == 2
+    _bitwise_equal(_run(plan), _run(result.root))
+
+
+def test_multiway_fuse_blocked_on_unstable_key():
+    """The second dimension keys on a column the FIRST build side
+    introduces ("region" is not leaf-PRESENT): fusing would probe a
+    column the original stream does not carry, so the rewriter refuses
+    with a typed diagnostic and the cascade runs unchanged."""
+    region_dim = cp.take(DeviceTable.from_pylists(
+        {"region": [f"r{i}" for i in range(5)],
+         "zone": [f"z{i}" for i in range(5)]},
+        device="cpu",
+    )).index_on("region").sync()
+    plan = P.Join(
+        P.Join(P.Scan(_fact()), _dim(), ("id",)),
+        region_dim,
+        ("region",),
+    )
+    result = optimize_plan(plan)
+    assert not any(r.startswith("multiway-fuse") for r in result.applied)
+    assert any(d.rule == "multiway-fuse" for d in result.blocked)
+    steps = result.recipe.steps if result.recipe else ()
+    assert not any(s[0] == "fuse_joins" for s in steps)
+    _bitwise_equal(_run(plan), _run(result.root))

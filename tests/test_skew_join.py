@@ -449,3 +449,183 @@ def test_merged_stages_sums_skew_extras():
     assert row.extra["rows_broadcast"] == 110
     assert row.extra["rows_repartitioned"] == 190
     assert row.extra["capacity"] == 256  # config-shaped: last wins
+
+
+# -- single-pass multiway join (ISSUE 17) ------------------------------
+#
+# The contract under test (ops/join.py multiway_join docstring): one
+# pass over the fact table resolves bounds against EVERY dimension's
+# DeviceIndex, the cross-product fanout is composed via cumsum offsets,
+# and the emitted table is bitwise-identical (row order, column order,
+# values) to ``join_tables`` applied left to right — without
+# materializing any intermediate.
+
+
+def _two_dim_stream(cust: np.ndarray, prod: np.ndarray) -> DeviceTable:
+    return DeviceTable.from_pylists(
+        {
+            "k": [f"c{int(v)}" for v in cust],
+            "p": [f"p{int(v)}" for v in prod],
+            "qty": [str(int(v) % 9) for v in cust],
+        },
+        device="cpu",
+    )
+
+
+def _mw_dim(prefix, key, payload, n_keys, dup_every=0):
+    """A dimension DeviceIndex keyed on *key*; ``dup_every`` adds a
+    second build row for every dup_every-th key (cross-product fanout).
+    ``DeviceIndex.build`` expects the build table key-sorted (the
+    ``index_on`` path sorts before building) — the stable sort keeps
+    duplicate-key payloads in insertion order."""
+    pairs = [(f"{prefix}{i}", f"v{i % 37}") for i in range(n_keys)]
+    if dup_every:
+        pairs += [(f"{prefix}{i}", f"dup{i}") for i in range(0, n_keys, dup_every)]
+    pairs.sort(key=lambda kv: kv[0])
+    return J.DeviceIndex.build(
+        DeviceTable.from_pylists(
+            {key: [p[0] for p in pairs], payload: [p[1] for p in pairs]},
+            device="cpu",
+        ),
+        [key],
+    )
+
+
+def _cascade(stream: DeviceTable, specs) -> DeviceTable:
+    out = stream
+    for dev_index, cols in specs:
+        out = J.join_tables(out, dev_index, cols)
+    return out
+
+
+def _mw_sums(t: DeviceTable):
+    return checksum_device_table(t, sorted(t.columns), positional=True), t.nrows
+
+
+@pytest.mark.parametrize("dist", ["uniform", "zipf"])
+@pytest.mark.parametrize("n_shards", [1, 8])
+def test_multiway_parity_vs_cascade(monkeypatch, dist, n_shards):
+    """The ISSUE 17 hard contract: full-result positional per-column
+    checksums of the single-pass multiway join equal the cascaded
+    reference on uniform AND Zipf keys, K in {1, 8} shards."""
+    monkeypatch.setattr(J.DeviceIndex, "PARTITION_MIN_KEYS", 1)
+    n_rows, n_cust, n_prod = 4_000, 500, 60
+    if dist == "zipf":
+        cust = _zipf_cust(n_rows, n_cust, 1.3, seed=31)
+        prod = _zipf_cust(n_rows, n_prod, 1.3, seed=32)
+    else:
+        rng = np.random.default_rng(33)
+        cust = rng.integers(0, n_cust, size=n_rows)
+        prod = rng.integers(0, n_prod, size=n_rows)
+    table = _two_dim_stream(cust, prod)
+    specs = [
+        (_mw_dim("c", "k", "name", n_cust), ("k",)),
+        (_mw_dim("p", "p", "price", n_prod), ("p",)),
+    ]
+    t = table.with_sharding(make_mesh(n_shards)) if n_shards > 1 else table
+    want_t = _cascade(t, specs)
+    got_t = J.multiway_join(t, specs)
+    # the hard contract: positional per-column checksums bitwise-equal
+    # to the cascaded path over the SAME (sharded) bytes ...
+    assert _mw_sums(got_t) == _mw_sums(want_t), (
+        f"multiway vs cascade ({dist}, K={n_shards})"
+    )
+    # ... and the decoded rows equal the unsharded cascade reference
+    assert got_t.to_rows() == _cascade(table, specs).to_rows()
+
+
+def test_multiway_empty_dimension():
+    """A zero-row dimension: the fused pass reproduces the cascade's
+    empty early-out — zero rows AND the cascade's exact column order."""
+    table = _two_dim_stream(np.arange(50) % 13, np.arange(50) % 7)
+    empty = J.DeviceIndex.build(
+        DeviceTable.from_pylists({"p": [], "price": []}, device="cpu"),
+        ["p"],
+    )
+    specs = [(_mw_dim("c", "k", "name", 100), ("k",)), (empty, ("p",))]
+    want_t = _cascade(table, specs)
+    got_t = J.multiway_join(table, specs)
+    assert got_t.nrows == want_t.nrows == 0
+    assert list(got_t.columns) == list(want_t.columns)
+    assert _mw_sums(got_t) == _mw_sums(want_t)
+
+
+def test_multiway_zero_matches_in_one_dim():
+    """Every probe of the SECOND dimension misses: the inner join drops
+    every row, exactly like the cascade (no phantom fanout)."""
+    cust = np.arange(200) % 40
+    prod = np.arange(200) + 10_000  # p10000... never built
+    table = _two_dim_stream(cust, prod)
+    specs = [
+        (_mw_dim("c", "k", "name", 40), ("k",)),
+        (_mw_dim("p", "p", "price", 60), ("p",)),
+    ]
+    want = _mw_sums(_cascade(table, specs))
+    got = _mw_sums(J.multiway_join(table, specs))
+    assert got == want
+    assert got[1] == 0
+
+
+def test_multiway_duplicate_build_keys_cross_product():
+    """Duplicate build keys in BOTH dimensions: the per-row fanout is the
+    PRODUCT of the per-dimension match counts, emitted in the cascade's
+    nesting order (outer dim varies slower)."""
+    cust = np.arange(300) % 20
+    prod = np.arange(300) % 10
+    table = _two_dim_stream(cust, prod)
+    specs = [
+        (_mw_dim("c", "k", "name", 20, dup_every=4), ("k",)),
+        (_mw_dim("p", "p", "price", 10, dup_every=3), ("p",)),
+    ]
+    want = _mw_sums(_cascade(table, specs))
+    got = _mw_sums(J.multiway_join(table, specs))
+    assert got == want
+    assert got[1] > table.nrows  # fanout actually expanded
+
+
+def test_multiway_hot_key_in_both_dims_sharded(monkeypatch, mesh):
+    """90% of the stream on ONE key in EACH dimension simultaneously:
+    the sketch samples every dimension's fact key column, both hot keys
+    ride the broadcast tier (per-dim routing counters), and the fused
+    result stays bitwise-equal to the unsharded cascade."""
+    monkeypatch.setattr(J.DeviceIndex, "PARTITION_MIN_KEYS", 1)
+    monkeypatch.setenv("CSVPLUS_JOIN_SKEW", "1")
+    n_rows = 16_000
+    cust, _ = _single_key_cust(n_rows, 400, 0.9, seed=41)
+    prod, _ = _single_key_cust(n_rows, 60, 0.9, seed=43)
+    table = _two_dim_stream(cust, prod)
+    specs = [
+        (_mw_dim("c", "k", "name", 400), ("k",)),
+        (_mw_dim("p", "p", "price", 60), ("p",)),
+    ]
+    host_rows = _cascade(table, specs).to_rows()
+    joinskew.reset()
+    got_t = J.multiway_join(table.with_sharding(mesh), specs)
+    assert got_t.to_rows() == host_rows
+    snap = joinskew.counters_snapshot()
+    for label in ("k", "p"):
+        assert snap[label]["hot_keys_detected"] >= 1, label
+        assert snap[label]["rows_broadcast"] > 0, label
+    mw = snap["k+p"]
+    assert mw["multiway_joins"] == 1
+    assert mw["multiway_dims"] == 2
+    assert mw["multiway_rows_in"] == n_rows
+
+
+def test_multiway_warm_zero_recompiles(monkeypatch, mesh):
+    """Warm re-executions of a sharded Zipf multiway join lower NOTHING:
+    the offsets/select/expand kernel statics repeat, so every registered
+    kernel hits its jit cache (RecompileWatch.assert_zero)."""
+    monkeypatch.setattr(J.DeviceIndex, "PARTITION_MIN_KEYS", 1)
+    cust = _zipf_cust(8_000, 300, 1.3, seed=51)
+    prod = _zipf_cust(8_000, 40, 1.3, seed=52)
+    table = _two_dim_stream(cust, prod).with_sharding(mesh)
+    specs = [
+        (_mw_dim("c", "k", "name", 300), ("k",)),
+        (_mw_dim("p", "p", "price", 40), ("p",)),
+    ]
+    want = _mw_sums(J.multiway_join(table, specs))  # cold pass compiles
+    with RecompileWatch() as watch:
+        for _ in range(2):
+            assert _mw_sums(J.multiway_join(table, specs)) == want
+    watch.assert_zero("warm multiway joins")
