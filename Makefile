@@ -1,7 +1,7 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal bench-view bench-opt trace-smoke obs-smoke skew-smoke multiway-smoke chaos check dryrun example coldcheck lint analyze asan
+.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal bench-view bench-opt bench-macro trace-smoke obs-smoke skew-smoke multiway-smoke fuse-smoke chaos check dryrun example coldcheck lint analyze asan
 
 test:
 	python -m pytest tests/ -x -q
@@ -10,7 +10,7 @@ test:
 # differential, mutable-index storage bench, materialized-view bench,
 # telemetry-plane smoke, skew-aware-join smoke — the set a change must
 # keep green before review.
-check: test lint chaos bench-delta bench-wal bench-view bench-opt obs-smoke skew-smoke multiway-smoke
+check: test lint chaos bench-delta bench-wal bench-view bench-opt obs-smoke skew-smoke multiway-smoke fuse-smoke
 
 # Static analysis gate (docs/ANALYSIS.md).  The repo AST lint (ctypes
 # boundary + jit retrace rules) always runs; ruff and mypy run when
@@ -206,6 +206,33 @@ skew-smoke:
 # gate failure.  The perf targets live in the bench-mesh multiway tier.
 multiway-smoke:
 	python bench.py --multiway-smoke
+
+# Probe-pass fusion smoke (ISSUE 19): a 200K-row Zipf Filter->Map->Join
+# chain on the hermetic 8-device mesh, served through the PlanCache —
+# the rewriter must fuse the run (plan-cache `fused_chains` counter, a
+# `fuse_chain` recipe step), the result must be BITWISE equal
+# (positional per-column checksums) to the CSVPLUS_FUSE=0 staged run
+# over the same bytes, the csvplus_plan_fusion_* families must ride a
+# metrics scrape, and repeated warm fused executions must lower nothing
+# (RecompileWatch).  Seconds long; one JSON line; exits nonzero on any
+# gate failure.  The perf targets live in bench-macro.
+fuse-smoke:
+	python bench.py --fuse-smoke
+
+# TPC-H-flavored macro-bench (ISSUE 19, ROADMAP item 1's workload):
+# five named query chains (multi-join stars, filters, projection, Top;
+# uniform and Zipf(s=1.1) keys; one on the 8-device mesh) run through
+# the PlanCache with the optimizer fused vs CSVPLUS_FUSE=0 in the SAME
+# child over identical bytes.  In-run gates: bitwise positional-
+# checksum parity per query, zero warm recompiles on the fused leg,
+# fused_chains >= 1, mesh-leg peak RSS within 10% of staged, at least
+# one query >= 1.25x fused-over-staged, and the q1 headline above half
+# bench_macro_floor.json.  Minutes long (1M-row facts; scale with
+# CSVPLUS_BENCH_MACRO_ROWS).  The checked-in record
+# (BENCH_MACRO_r18.json, with per-stage obs-diff attribution per
+# query) is only (re)written when CSVPLUS_BENCH_MACRO_OUT is set.
+bench-macro:
+	python bench_macro.py
 
 # Fault-injection differential gate (docs/RESILIENCE.md): seeded fault
 # schedules against serve load, K-worker streamed ingest, and the

@@ -1939,6 +1939,172 @@ def _multiway_smoke() -> int:
     return 0
 
 
+def _fuse_smoke() -> int:
+    """The `make fuse-smoke` tier (ISSUE 19): the probe-pass fusion's
+    correctness contract in seconds, hermetic 8-device CPU mesh (the
+    perf targets live in `make bench-macro` — this gate is the cheap
+    every-`make check` correctness leg).
+
+    Gates, ONE JSON line on stdout, nonzero exit on any failure:
+
+    1. the rewriter actually FUSED: pass 5 absorbs the Filter->Map run
+       into the probe (a ``fuse_chain`` recipe step, the plan cache's
+       ``fused_chains`` counter — not assumed from the env flag);
+    2. bitwise parity: positional per-column checksums of the fused
+       serving identical to the disarmed ``CSVPLUS_FUSE=0`` staged run
+       over the same Zipf(s=1.1) bytes, region-restricted dimension
+       (probe misses engage the composed-emit path);
+    3. zero warm recompiles across repeated fused executions
+       (``RecompileWatch.assert_zero``);
+    4. the ``csvplus_plan_fusion_*`` counter family landed in the
+       process-global registry and rides a metrics scrape.
+    """
+    if os.environ.get("CSVPLUS_FUSE_SMOKE_HERMETIC") != "1":
+        env = dict(os.environ)
+        env["CSVPLUS_FUSE_SMOKE_HERMETIC"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+    import numpy as np
+
+    import csvplus_tpu as cp
+    from csvplus_tpu import plan as P
+    from csvplus_tpu.columnar.table import DeviceTable
+    from csvplus_tpu.exprs import SetValue
+    from csvplus_tpu.obs.memory import host_header
+    from csvplus_tpu.obs.metrics import TelemetryPlane
+    from csvplus_tpu.obs.recompile import RecompileWatch
+    from csvplus_tpu.parallel.mesh import make_mesh
+    from csvplus_tpu.predicates import Like, Not
+    from csvplus_tpu.serve.plancache import PlanCache
+    from csvplus_tpu.utils.checksum import checksum_device_table
+
+    n_rows = int(os.environ.get("CSVPLUS_FUSE_SMOKE_ROWS", 200_000))
+    n_keys = 2_000
+
+    t0_all = time.perf_counter()
+    rng = np.random.default_rng(20260807)
+    cust = zipf_probe_values(rng.permutation(n_keys), n_rows, s=1.1, seed=1)
+    arange = np.arange(n_rows)
+    stream = DeviceTable.from_pylists(
+        {
+            "cust_id": [f"c{int(v)}" for v in cust],
+            "cat": np.char.add("k", (arange % 16).astype(np.str_)).tolist(),
+            "qty": (arange % 100).astype(np.str_).tolist(),
+        },
+        device="cpu",
+    ).with_sharding(make_mesh(8))
+    # region-restricted dimension (every 7th customer): most probes
+    # miss, so the fused merge takes the composed-emit path rather
+    # than the all-matched identity shape
+    ids = [i for i in range(n_keys) if i % 7 == 1]
+    cust_idx = cp.take(DeviceTable.from_pylists(
+        {
+            "cust_id": [f"c{i}" for i in ids],
+            "name": [f"n{i % 97}" for i in ids],
+        },
+        device="cpu",
+    )).index_on("cust_id").sync()
+    plan = P.SelectCols(
+        P.Join(
+            P.MapExpr(
+                P.Filter(P.Scan(stream), Not(Like({"cat": "k1"}))),
+                SetValue("flag", "y"),
+            ),
+            cust_idx,
+            ("cust_id",),
+        ),
+        ("cust_id", "name", "qty", "flag"),
+    )
+
+    def sums(cache):
+        out = cache.execute(plan)
+        assert out.nrows > 0
+        return checksum_device_table(out, sorted(out.columns), positional=True)
+
+    # disarmed leg first: CSVPLUS_FUSE=0 must restore the staged
+    # execution byte-for-byte, through the same PlanCache surface
+    os.environ["CSVPLUS_FUSE"] = "0"
+    try:
+        staged_sums = sums(PlanCache())
+    finally:
+        os.environ.pop("CSVPLUS_FUSE", None)
+    cache = PlanCache()
+    fused_sums = sums(cache)  # cold fused pass compiles the kernels
+    stats = cache.stats()
+    exe = cache.executable_for(plan)
+    steps = [s[0] for s in (exe.recipe.steps if exe and exe.recipe else ())]
+    if stats.get("fused_chains", 0) < 1 or "fuse_chain" not in steps:
+        sys.stderr.write(
+            f"fuse-smoke FAILED: pass 5 did not fuse the chain (plan"
+            f" cache stats: {stats}, recipe steps: {steps})\n"
+        )
+        return 1
+    if fused_sums != staged_sums:
+        sys.stderr.write(
+            f"fuse-smoke FAILED: checksum parity broke:"
+            f" {fused_sums} != {staged_sums}\n"
+        )
+        return 1
+    with RecompileWatch() as watch:
+        for _ in range(2):
+            if sums(cache) != staged_sums:
+                sys.stderr.write(
+                    "fuse-smoke FAILED: warm fused pass diverged\n"
+                )
+                return 1
+        recompiles = watch.delta()
+    if recompiles:
+        sys.stderr.write(
+            f"fuse-smoke FAILED: warm recompiles {recompiles}\n"
+        )
+        return 1
+
+    scrape = TelemetryPlane().registry.render()
+    missing = [
+        fam
+        for fam in (
+            "csvplus_plan_fusion_total",
+            "csvplus_plan_fusion_rows_full_total",
+            "csvplus_plan_fusion_rows_selected_total",
+            "csvplus_plan_fusion_rows_out_total",
+        )
+        if fam not in scrape
+    ]
+    if missing:
+        sys.stderr.write(
+            f"fuse-smoke FAILED: scrape is missing {missing}\n"
+        )
+        return 1
+    record = {
+        "metric": "fuse_smoke",
+        "value": stats["fused_chains"],
+        "unit": "fused_chains",
+        "rows": n_rows,
+        "n_keys": n_keys,
+        "zipf_s": 1.1,
+        "recipe_steps": steps,
+        "fusion_refused": stats.get("fusion_refused", 0),
+        "parity_bitwise": True,
+        "warm_recompiles": 0,
+        "wall_sec": round(time.perf_counter() - t0_all, 1),
+        **host_header(),
+    }
+    print(json.dumps(record), flush=True)
+    sys.stderr.write(
+        f"fuse-smoke ok: Filter->Map->Join fused by pass 5"
+        f" (fused_chains={stats['fused_chains']}), bitwise parity vs"
+        f" CSVPLUS_FUSE=0, fusion families on the scrape, zero warm"
+        f" recompiles ({record['wall_sec']}s)\n"
+    )
+    return 0
+
+
 def _bench_mesh() -> int:
     """The `make bench-mesh` tier: the sharded north-star pipeline on
     the virtual 8-device CPU mesh, with the same floor contract as
@@ -2916,4 +3082,10 @@ if __name__ == "__main__":
         # family on the scrape, zero warm recompiles — the function
         # re-execs itself into the hermetic 8-device CPU env
         sys.exit(_multiway_smoke())
+    if "--fuse-smoke" in sys.argv:
+        # probe-pass fusion smoke: pass 5 fuses Filter->Map->Join,
+        # bitwise parity vs the disarmed CSVPLUS_FUSE=0 staged run,
+        # fusion counter family on the scrape, zero warm recompiles —
+        # the function re-execs itself into the hermetic 8-device env
+        sys.exit(_fuse_smoke())
     main()

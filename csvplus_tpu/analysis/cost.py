@@ -37,6 +37,7 @@ from .schema import placement_of_column
 
 __all__ = [
     "CostEstimate",
+    "choose_fusion",
     "choose_join_operator",
     "estimate_plan",
     "predicate_selectivity",
@@ -267,6 +268,38 @@ def estimate_plan(
                         bucket.setdefault(name, b)
                         distinct.setdefault(name, DEFAULT_DISTINCT)
             note = f"multiway x{len(node.joins)}: " + " | ".join(dim_notes)
+        elif isinstance(node, P.FusedProbe):
+            # Absorbed filters narrow first (that is the fused win: the
+            # selection shrinks BEFORE the fan-out), then the probe
+            # dimensions fold exactly like MultiwayJoin; the absorbed
+            # projection/map footprint rides the generic facts-based
+            # schema evolution below.
+            sels: List[float] = []
+            for kind, payload in node.ops:
+                if kind == "filter":
+                    s = predicate_selectivity(payload, distinct)
+                    sels.append(s)
+                    rows *= s
+            dim_notes = []
+            for index, _cols in node.joins:
+                fanout, rep, dnote, info = _probe_cost(index, sketches)
+                rows *= max(fanout, MIN_SELECTIVITY)
+                replicated += rep
+                dim_notes.append(dnote)
+                if info is not None:
+                    kinds, meta = info[0], info[3]
+                    place = (meta or {}).get("placement")
+                    b = ("device" if place is None or place.kind != "host"
+                         else "host")
+                    for name in kinds:
+                        bucket.setdefault(name, b)
+                        distinct.setdefault(name, DEFAULT_DISTINCT)
+            if sels:
+                sel = 1.0
+                for s in sels:
+                    sel *= s
+            note = (f"fused probe x{len(node.joins)}: "
+                    + " | ".join(dim_notes))
 
         # Schema evolution from provenance facts.
         if f.keeps_only is not None:
@@ -460,3 +493,172 @@ def choose_join_operator(
         "multiway_bytes": round(multiway_bytes, 1),
         "chosen": chosen,
     }
+
+
+def choose_fusion(
+    root: P.PlanNode,
+    sketches: Optional[Dict[str, Any]] = None,
+) -> Optional[Dict[str, Any]]:
+    """Price the maximal absorbable Filter/Map/projection run ending at
+    the chain's first probe (``Join``/``MultiwayJoin``) both ways —
+    staged (the executor materializes the selected stream FULL-WIDTH
+    before probing: every live column gathers down to the selection)
+    versus fused (``FusedProbe``: only the distinct key columns gather
+    for probing; everything else rides the emit gather both operators
+    share) — and return the per-placement comparison.
+
+    The decision is per placement lane, the r06 lesson (whole-program
+    fusion regressed mesh RSS while total-bytes pricing approved it):
+    ``chosen == "fuse"`` only when the fused bytes are <= the staged
+    bytes on EVERY lane and strictly smaller in total.  The replicated
+    lane is identical under both operators (the same build sides
+    broadcast either way) and is excluded.  A run whose staged
+    materialize is provably a passthrough (identity selection over
+    unpadded storage, no absorbed filter and nothing narrowing above
+    it) is refused outright — fusing it saves nothing.
+
+    Advisory like everything in this module: the rewriter only fuses
+    when provenance licenses every absorbed op (``analysis/rewrite.py``
+    pass 5); ``explain`` renders the comparison either way.  Returns
+    ``None`` when the chain has no probe; ``blocked_by`` names the
+    opaque Filter/Map op bounding the run from below, if one does.
+    """
+    if sketches is None:
+        from ..obs.joinskew import joinskew
+
+        sketches = joinskew.build_sketches()
+    chain = P.linearize(root)
+    facts = [PV.stage_facts(i, n) for i, n in enumerate(chain)]
+    probe = None
+    for i in range(1, len(chain)):
+        if isinstance(chain[i], (P.Join, P.MultiwayJoin)):
+            probe = i
+            break
+    if probe is None:
+        return None
+
+    def absorbable(f: PV.StageFacts) -> bool:
+        # the provenance license, purely structural: a known-footprint,
+        # row-linear, non-aborting op of an absorbable kind
+        return (
+            f.op in ("Filter", "MapExpr", "SelectCols", "DropCols")
+            and not f.barrier
+            and f.reads is not None
+            and f.row_linear
+            and not f.aborting
+        )
+
+    start = probe
+    while start - 1 >= 1 and absorbable(facts[start - 1]):
+        start -= 1
+    blocked_by = None
+    if start - 1 >= 1 and facts[start - 1].op in (
+        "Filter", "MapExpr", "SelectCols", "DropCols"
+    ):
+        # an op of an absorbable KIND that failed the license: an
+        # opaque predicate/expr bounds the run from below
+        blocked_by = facts[start - 1].label
+
+    _KINDS = {
+        P.Filter: "filter", P.MapExpr: "map",
+        P.SelectCols: "select", P.DropCols: "drop",
+    }
+    ops = [_KINDS[type(n)] for n in chain[start:probe]]
+    pnode = chain[probe]
+    joins = (
+        pnode.joins if isinstance(pnode, P.MultiwayJoin)
+        else ((pnode.index, tuple(pnode.columns)),)
+    )
+    ests = estimate_plan(root, sketches=sketches)
+    rows_in = ests[start - 1].rows
+    rows_selected = ests[probe - 1].rows
+
+    out: Dict[str, Any] = {
+        "run": [facts[p].label for p in range(start, probe + 1)],
+        "slots": list(range(start, probe + 1)),
+        "ops": ops,
+        "dims": len(joins),
+        "est_rows_in": round(rows_in, 1),
+        "est_rows_selected": round(rows_selected, 1),
+        "blocked_by": blocked_by,
+    }
+
+    # Staged leg: the pre-probe materialize gathers every live column
+    # down to the selection — exactly the bytes of the chain state
+    # entering the probe, per placement lane.
+    staged_host = ests[probe - 1].bytes_host
+    staged_device = ests[probe - 1].bytes_device
+
+    # Fused leg: only the distinct key columns gather for probing.
+    key_cols: set = set()
+    for _idx, cols in joins:
+        key_cols |= set(cols)
+    leaf = chain[0]
+    table = getattr(leaf, "table", None)
+    leaf_cols = getattr(table, "columns", None) or {}
+    fused_host = fused_device = 0.0
+    for c in sorted(key_cols):
+        col = leaf_cols.get(c)
+        b = _placement_bucket(col) if col is not None else "device"
+        if b == "host":
+            fused_host += rows_selected * BYTES_PER_CELL
+        else:
+            fused_device += rows_selected * BYTES_PER_CELL
+
+    out.update({
+        "staged_bytes_host": round(staged_host, 1),
+        "staged_bytes_device": round(staged_device, 1),
+        "fused_bytes_host": round(fused_host, 1),
+        "fused_bytes_device": round(fused_device, 1),
+    })
+
+    if not ops:
+        out.update({"chosen": "staged",
+                    "note": "no absorbable run before the probe"})
+        return out
+
+    # Is the staged materialize real?  materialize() passes through on
+    # an identity selection over unpadded storage; it is a real gather
+    # only when something narrowed the selection (an absorbed filter or
+    # a narrowing stage above the leaf) or the storage is padded /
+    # range-restricted.
+    nrows = int(getattr(table, "nrows", 0) or 0)
+    stored = nrows
+    if leaf_cols:
+        try:
+            stored = len(next(iter(leaf_cols.values())))
+        except TypeError:
+            stored = nrows
+    padded_leaf = table is not None and stored != nrows
+    partial_lookup = isinstance(leaf, P.Lookup) and (
+        leaf.lower != 0 or leaf.upper != nrows
+    )
+    narrowed_before = any(
+        facts[p].multiplicity == PV.NARROW for p in range(1, start)
+    )
+    if not ("filter" in ops or padded_leaf or partial_lookup
+            or narrowed_before):
+        out.update({"chosen": "staged",
+                    "note": "identity stream: staged materialize is free"})
+        return out
+
+    per_lane_ok = (
+        fused_host <= staged_host and fused_device <= staged_device
+    )
+    strictly_cheaper = (
+        fused_host + fused_device < staged_host + staged_device
+    )
+    if per_lane_ok and strictly_cheaper:
+        out.update({
+            "chosen": "fuse",
+            "note": (f"fused probe gathers {len(key_cols)} key column(s) "
+                     "for the selection; the staged materialize of every "
+                     "live column never happens"),
+        })
+    else:
+        out.update({
+            "chosen": "staged",
+            "note": ("staged materialize prices no worse than the fused "
+                     "key gathers on some placement lane"),
+        })
+    return out

@@ -220,6 +220,55 @@ def stage_facts(pos: int, node: P.PlanNode) -> StageFacts:
         return StageFacts(label, op, keys,
                           fallback_writes=fallback, multiplicity=EXPAND,
                           may_error=True)
+    if isinstance(node, P.FusedProbe):
+        # The fused probe pass (ISSUE 19) composes its absorbed ops'
+        # facts via ``fused_op_node`` — each op contributes exactly what
+        # its standalone stage would, BY CONSTRUCTION — then folds the
+        # probe dimensions like MultiwayJoin.  ``keeps_only`` intersects
+        # the absorbed selects (sound over-approximation: the true kept
+        # set is the last select's list minus later removes, a subset of
+        # the intersection's complement's complement — every consumer of
+        # ``keeps_only`` treats it as "at most these survive").
+        reads: set = set()
+        writes: set = set()
+        removes: set = set()
+        keeps_only: Optional[frozenset] = None
+        may_error = False
+        for kind, payload in node.ops:
+            sub = P.fused_op_node(kind, payload)
+            if sub is None:
+                return StageFacts(label, op, None, row_linear=False,
+                                  order_preserving=False, barrier=True)
+            f = stage_facts(pos, sub)
+            if f.barrier or f.reads is None:
+                return StageFacts(label, op, None, row_linear=False,
+                                  order_preserving=False, barrier=True)
+            reads |= f.reads
+            writes |= f.writes
+            removes |= f.removes
+            if f.keeps_only is not None:
+                keeps_only = (
+                    f.keeps_only if keeps_only is None
+                    else keeps_only & f.keeps_only
+                )
+            may_error = may_error or f.may_error
+        keys = frozenset().union(
+            *(frozenset(cols) for _idx, cols in node.joins)
+        )
+        reads |= keys
+        fallback: Optional[frozenset] = _EMPTY
+        for idx, cols in node.joins:
+            info = device_index_static_info(idx)
+            if info is None or not info[2]:
+                fallback = None  # a build-side schema is unknown
+                break
+            fallback = fallback | (frozenset(info[0]) - frozenset(cols))
+        return StageFacts(label, op, frozenset(reads),
+                          writes=frozenset(writes),
+                          removes=frozenset(removes),
+                          keeps_only=keeps_only,
+                          fallback_writes=fallback, multiplicity=EXPAND,
+                          may_error=True)
     # Unknown node type: total barrier — and no row-linearity claim.
     return StageFacts(label, op, None, row_linear=False,
                       order_preserving=False, barrier=True)
@@ -387,6 +436,8 @@ def live_columns(facts: Sequence[StageFacts],
         if f.barrier or f.reads is None:
             return None
         live |= f.reads | f.writes
-        if f.fallback_writes is None and f.op in ("Join", "MultiwayJoin"):
+        if f.fallback_writes is None and f.op in (
+            "Join", "MultiwayJoin", "FusedProbe"
+        ):
             return None
     return frozenset(live)

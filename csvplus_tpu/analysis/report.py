@@ -135,6 +135,17 @@ def example_plans() -> Dict[str, object]:
     out["quickstart-join"] = P.Join(
         P.Join(P.Scan(orders_t), cust_idx, ("cust_id",)), prod_idx, ()
     )
+    # ISSUE 19: the probe-fusion shape — a filter + map run on the fact
+    # side absorbed into the probe pass (pass 5); the snapshot pins the
+    # pricing rule's fuse-vs-staged decision and the fused recipe step
+    out["fused-probe-chain"] = P.Join(
+        P.MapExpr(
+            P.Filter(P.Scan(orders_t), Like({"qty": "3"})),
+            SetValue("src", "bench"),
+        ),
+        cust_idx,
+        ("cust_id",),
+    )
     # examples/sharded_join.py: mesh-sharded stream probing a
     # single-device index (the benign-replication placement shape)
     if len(jax.devices()) >= 8:
@@ -254,7 +265,7 @@ def plan_analysis_json(root) -> dict:
     """Everything the suite knows about one plan: verifier verdict,
     provenance table, cost table, join-order ranking, rewrite decision.
     The per-plan payload entry and the ``explain --json`` body."""
-    from .cost import choose_join_operator, rank_join_orders
+    from .cost import choose_fusion, choose_join_operator, rank_join_orders
 
     report = verify_plan(root)
     d = report_json(report)
@@ -262,6 +273,7 @@ def plan_analysis_json(root) -> dict:
     d["cost"] = cost_json(root)
     d["join_orders"] = rank_join_orders(root, report, sketches={})
     d["join_operator"] = choose_join_operator(root, sketches={})
+    d["fusion"] = choose_fusion(root, sketches={})
     d["rewrite"] = rewrite_json(root, report)
     return d
 
@@ -343,6 +355,22 @@ def explain_text(name: str, root) -> str:
             f"bounds, no intermediate",
             f"  chosen     : {op['chosen']}",
         ]
+    fu = d.get("fusion")
+    if fu is not None:
+        lines += [
+            "",
+            "probe-pass fusion (staged materialize vs fused key gathers):",
+            f"  run: {' -> '.join(fu['run'])} ({len(fu['ops'])} op(s) + "
+            f"{fu['dims']}-dim probe, est {fu['est_rows_in']:.0f} rows in"
+            f" -> {fu['est_rows_selected']:.0f} selected)",
+            f"  staged     : {fu['staged_bytes_host']:>14.1f} B host /"
+            f" {fu['staged_bytes_device']:>14.1f} B device materialized",
+            f"  fused      : {fu['fused_bytes_host']:>14.1f} B host /"
+            f" {fu['fused_bytes_device']:>14.1f} B device key gathers",
+            f"  chosen     : {fu['chosen']} ({fu['note']})",
+        ]
+        if fu.get("blocked_by"):
+            lines.append(f"  blocked by : {fu['blocked_by']}")
     rw = d["rewrite"]
     lines.append("")
     if "error" in rw:

@@ -1,6 +1,6 @@
-"""The verifier-checked plan rewriter (ISSUE 16 + 17, ROADMAP item 1).
+"""The verifier-checked plan rewriter (ISSUE 16 + 17 + 19, ROADMAP item 1).
 
-``optimize_plan`` applies exactly five rewrite rules, each one only
+``optimize_plan`` applies exactly six rewrite rules, each one only
 when the provenance domain (:mod:`.provenance`) PROVES it bitwise-safe
 against the executor's semantics, and records a typed
 :class:`~.provenance.ProvenanceDiagnostic` naming the blocking stage
@@ -28,11 +28,26 @@ for every refusal:
   later key from an earlier build side (stream-wins merge) nor raise
   a key error at an intermediate row number the fused pass would
   report differently.  ``CSVPLUS_MULTIWAY=0`` disables just this rule;
+* **probe-pass fusion** (ISSUE 19) — the licensed Filter/Map/projection
+  run immediately before the chain's first probe collapses into one
+  fused :class:`~csvplus_tpu.plan.FusedProbe` physical operator when
+  the per-placement pricing rule (:func:`~.cost.choose_fusion`)
+  approves: the absorbed ops evaluate against the executor's lazy
+  selection view and the probe consumes the selection directly, so the
+  staged pre-join ``materialize()`` (a full-width gather of every live
+  column) never happens and the emit gather composes through the
+  selection instead — bitwise-identical by gather associativity.  The
+  license is structural (every absorbed op row-linear with a known
+  footprint — the ops execute through the SAME executor code paths,
+  only the node boundary moves, so no new presence obligations arise);
+  the pricing is per placement lane, the r06 RSS lesson.
+  ``CSVPLUS_FUSE=0`` disables just this rule;
 * **projection pushdown** — leaf columns no stage reads or writes and
   the final schema omits are dropped right after the leaf
   (:func:`~.provenance.live_columns`); a ``DropCols`` there is a pure
   dict filter with no error semantics, and the big win is ``Join``'s
-  ``materialize()`` no longer gathering dead columns.
+  ``materialize()`` — or the fused pass's key/emit gathers — no longer
+  touching dead columns.
 
 The rewritten plan is re-verified with the existing static verifier and
 the EQUIVALENCE VERDICT is asserted: admission verdict (``ok``) and
@@ -76,6 +91,7 @@ __all__ = [
     "PlanRecipe",
     "RewriteResult",
     "RewriteVerdictMismatch",
+    "fuse_enabled",
     "multiway_enabled",
     "optimize_enabled",
     "optimize_plan",
@@ -96,6 +112,14 @@ def multiway_enabled() -> bool:
     return optimize_enabled() and os.environ.get("CSVPLUS_MULTIWAY", "1") != "0"
 
 
+def fuse_enabled() -> bool:
+    """The probe-pass fusion rule's own hatch (``CSVPLUS_FUSE=0``),
+    nested under the global ``CSVPLUS_OPTIMIZE`` switch — the
+    macro-bench's staged leg runs with the optimizer ON but fusion OFF
+    so both legs share every other rewrite."""
+    return optimize_enabled() and os.environ.get("CSVPLUS_FUSE", "1") != "0"
+
+
 class RewriteVerdictMismatch(CsvPlusError):
     """Re-verifying the rewritten plan produced a different verdict
     than the original — the rewrite is discarded and this is raised so
@@ -110,7 +134,10 @@ class PlanRecipe:
     (a reordering of the :func:`~csvplus_tpu.plan.linearize` chain),
     ``("fuse_joins", lo, k)`` (collapse the ``k`` consecutive ``Join``
     stages starting at post-permute slot ``lo`` into one
-    :class:`~csvplus_tpu.plan.MultiwayJoin`), or
+    :class:`~csvplus_tpu.plan.MultiwayJoin`),
+    ``("fuse_chain", s, m)`` (collapse the ``m`` stages starting at
+    slot ``s`` — a Filter/Map/projection run ending in a probe — into
+    one :class:`~csvplus_tpu.plan.FusedProbe`), or
     ``("drop_after_leaf", columns)``.  ``require_present`` are leaf
     columns whose cells must be PRESENT for the proofs to hold on the
     submitted table.  ``join_order`` is the cost-chosen execution order
@@ -156,6 +183,35 @@ def apply_recipe(root: P.PlanNode, recipe: PlanRecipe) -> P.PlanNode:
                 raise ValueError("fuse_joins step does not address a Join run")
             joins = tuple((s.index, tuple(s.columns)) for s in run)
             chain[lo:lo + k] = [P.MultiwayJoin(run[0].child, joins)]
+        elif step[0] == "fuse_chain":
+            s, m = int(step[1]), int(step[2])
+            run = chain[s:s + m]
+            kinds = {P.Filter: "filter", P.MapExpr: "map",
+                     P.SelectCols: "select", P.DropCols: "drop"}
+            last = run[-1] if run else None
+            if (len(run) != m or m < 2
+                    or not isinstance(last, (P.Join, P.MultiwayJoin))
+                    or not all(type(nd) in kinds for nd in run[:-1])):
+                raise ValueError(
+                    "fuse_chain step does not address an op run ending "
+                    "in a probe")
+            ops = []
+            for nd in run[:-1]:
+                kind = kinds[type(nd)]
+                if kind == "filter":
+                    payload = nd.pred
+                elif kind == "map":
+                    payload = nd.expr
+                else:
+                    payload = tuple(nd.columns)
+                ops.append((kind, payload))
+            joins = (
+                last.joins if isinstance(last, P.MultiwayJoin)
+                else ((last.index, tuple(last.columns)),)
+            )
+            chain[s:s + m] = [
+                P.FusedProbe(run[0].child, tuple(ops), tuple(joins))
+            ]
         elif step[0] == "drop_after_leaf":
             chain.insert(1, P.DropCols(chain[0], tuple(step[1])))
         else:  # unknown step kind: a recipe from a newer writer — refuse
@@ -381,10 +437,49 @@ def optimize_plan(root: P.PlanNode, report=None, *,
                     f"intermediate vs multiway "
                     f"{choice['multiway_bytes']:.0f}B)")
 
-    # 5. Projection pushdown: drop dead leaf columns right after the
+    # 5. Probe-pass fusion (ISSUE 19): absorb the licensed Filter/Map/
+    # projection run immediately before the chain's first probe into
+    # one FusedProbe when the per-placement pricing approves.  The
+    # license is structural — choose_fusion only extends the run across
+    # ops whose provenance facts are row-linear with a known footprint,
+    # and the absorbed ops execute through the SAME executor code paths
+    # (masks, metadata updates, error sites), only the node boundary
+    # moves — so fusion adds NO presence obligations; parity is by
+    # construction (gather associativity), re-checked by the verdict
+    # equivalence below like every other rule.
+    if fuse_enabled():
+        from .cost import choose_fusion
+
+        cur = apply_recipe(root, PlanRecipe(tuple(steps))) if steps else root
+        fchoice = choose_fusion(cur, sketches=sketches)
+        if fchoice is not None:
+            if fchoice.get("blocked_by"):
+                blocked.append(ProvenanceDiagnostic(
+                    "probe-fuse", fchoice["blocked_by"],
+                    "opaque predicate/expr bounds the absorbable run — "
+                    "its column footprint is unknown"))
+            if fchoice["chosen"] == "fuse" and fchoice["ops"]:
+                s = int(fchoice["slots"][0])
+                m = len(fchoice["slots"])
+                steps.append(("fuse_chain", s, m))
+                staged_b = (fchoice["staged_bytes_host"]
+                            + fchoice["staged_bytes_device"])
+                fused_b = (fchoice["fused_bytes_host"]
+                           + fchoice["fused_bytes_device"])
+                applied.append(
+                    f"probe-fuse: {len(fchoice['ops'])} op(s) fused into "
+                    f"the probe at slot {s} (est staged materialize "
+                    f"{staged_b:.0f}B vs fused key gathers {fused_b:.0f}B)")
+            elif fchoice["ops"]:
+                blocked.append(ProvenanceDiagnostic(
+                    "probe-fuse", fchoice["run"][-1],
+                    f"cost model prices staged cheaper "
+                    f"({fchoice['note']})"))
+
+    # 6. Projection pushdown: drop dead leaf columns right after the
     # leaf.  Liveness is order-independent (a union over stage
-    # footprints, identical for the fused operator by construction), so
-    # neither the permutation nor the fuse above changes it.
+    # footprints, identical for the fused operators by construction), so
+    # neither the permutation nor the fuses above change it.
     final_schema = tuple(report.states[-1].schema.keys())
     live = PV.live_columns(facts[1:], final_schema)
     if live is None:

@@ -418,6 +418,9 @@ class _Verifier:
         if isinstance(node, P.MultiwayJoin):
             return self._transfer_multiway(node, state)
 
+        if isinstance(node, P.FusedProbe):
+            return self._transfer_fused(node, state)
+
         if isinstance(node, P.Except):
             return self._transfer_except(node, state)
 
@@ -652,6 +655,30 @@ class _Verifier:
     def _transfer_multiway(
         self, node: P.MultiwayJoin, state: NodeState
     ) -> NodeState:
+        for index, columns in node.joins:
+            state = self._join_schema_step(index, columns, state, "join")
+        return state
+
+    def _transfer_fused(
+        self, node: P.FusedProbe, state: NodeState
+    ) -> NodeState:
+        """The fused probe pass (ISSUE 19) folds its absorbed ops'
+        transfers via ``fused_op_node`` — each op's abstract step IS its
+        standalone stage's, BY CONSTRUCTION — then the join schema step
+        per dimension like MultiwayJoin.  The rewriter's verdict
+        re-check therefore holds structurally: fusing a licensed run
+        folds exactly the transfers the staged chain folded, in the
+        same order (diagnostics attribute to the FusedProbe's label)."""
+        for kind, payload in node.ops:
+            sub = P.fused_op_node(kind, payload)
+            if sub is None:
+                self.diag(
+                    "unlowerable",
+                    "error",
+                    f"no device lowering for fused op {kind!r}",
+                )
+                continue
+            state = self.transfer(sub, state, is_last=False)
         for index, columns in node.joins:
             state = self._join_schema_step(index, columns, state, "join")
         return state

@@ -316,6 +316,67 @@ def _exec_stage(view: "_View", node: P.PlanNode) -> "_View":
             joined.nrows,
             identity=join_cols_len == joined.nrows,
         )
+    elif isinstance(node, P.FusedProbe):
+        # Fused probe pass (ISSUE 19): the absorbed Filter/Map/projection
+        # run executes against the SAME lazy-view code paths the staged
+        # stages use (so masks, metadata updates and error sites are
+        # identical), and the probe then consumes the selection directly
+        # — the staged pre-join ``materialize()`` never happens
+        # (``multiway_join_selected`` composes the emit gather through
+        # the selection instead).
+        rows_full = int(view.sel.shape[0])
+        for kind, payload in node.ops:
+            if kind == "filter":
+                view.sel = view.sel[_sel_mask(view, payload)]
+            elif kind == "map":
+                _apply_map(view, payload)
+            elif kind == "select":
+                _apply_select(view, payload)
+            elif kind == "drop":
+                view.cols = {
+                    n: c for n, c in view.cols.items() if n not in set(payload)
+                }
+            else:
+                raise UnsupportedPlan(f"no device lowering for fused op {kind!r}")
+        specs = []
+        for index, columns in node.joins:
+            dev_index = index.device_table
+            if dev_index is None or not dev_index.supported:
+                raise UnsupportedPlan(
+                    "join build side has no packed device index"
+                )
+            _check_key_cells(view, columns)
+            specs.append((dev_index, tuple(columns)))
+        rows_selected = int(view.sel.shape[0])
+        if rows_selected == 0:
+            # nothing selected: delegate to the staged join, whose empty
+            # folds define the result schema — materialize is free here
+            # (gathering zero rows), so the fused path has nothing to win
+            joined = J.multiway_join(view.materialize(), specs)
+        else:
+            try:
+                joined = J.multiway_join_selected(
+                    view.cols, view.sel, view.device, specs,
+                    identity=view.identity,
+                )
+            except MissingColumnError as e:  # backstop; _check_key_cells covers it
+                raise DataSourceError(0, e) from e
+        from ..obs.joinskew import joinskew
+
+        joinskew.on_fused(
+            "+".join(",".join(di.key_columns) for di, _ in specs),
+            len(specs), rows_full, rows_selected, joined.nrows,
+        )
+        join_cols_len = (
+            len(next(iter(joined.columns.values()))) if joined.columns else 0
+        )
+        view = _View(
+            dict(joined.columns),
+            jnp.arange(joined.nrows, dtype=jnp.int32),
+            joined.device,
+            joined.nrows,
+            identity=join_cols_len == joined.nrows,
+        )
     elif isinstance(node, P.Except):
         dev_index = node.index.device_table
         if dev_index is None or not dev_index.supported:

@@ -98,6 +98,32 @@ class JoinSkewStats:
                 + int(intermediate_rows_avoided)
             )
 
+    def on_fused(
+        self,
+        label: str,
+        dims: int,
+        rows_full: int,
+        rows_selected: int,
+        rows_out: int,
+    ) -> None:
+        """Fold one fused probe-pass execution (ISSUE 19) into the
+        label's counters — the ``csvplus_plan_fusion_*`` evidence that a
+        FusedProbe engaged, how many fact rows the absorbed filters cut
+        before the fan-out (*rows_full* entering vs *rows_selected*
+        probed), and how many rows it emitted.  One lock round, keys
+        disjoint from the other families."""
+        with self._lock:
+            c = self._counters.get(label)
+            if c is None:
+                c = self._counters[label] = {}
+            c["fused_probes"] = c.get("fused_probes", 0) + 1
+            c["fused_dims"] = c.get("fused_dims", 0) + int(dims)
+            c["fused_rows_full"] = c.get("fused_rows_full", 0) + int(rows_full)
+            c["fused_rows_selected"] = (
+                c.get("fused_rows_selected", 0) + int(rows_selected)
+            )
+            c["fused_rows_out"] = c.get("fused_rows_out", 0) + int(rows_out)
+
     def build_sketch(self, label: str) -> SpaceSaving:
         """Get-or-create the label's build-side sketch."""
         with self._lock:

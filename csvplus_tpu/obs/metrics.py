@@ -839,7 +839,9 @@ class TelemetryPlane:
         tier and the hash-repartition exchange — plus the single-pass
         multiway join's engagement counters (``csvplus_join_multiway_*``:
         executions, fact rows in/out, and the cascade intermediate rows
-        the fusion avoided).  Reads the process-global registry, so
+        the fusion avoided) and the fused probe pass's
+        (``csvplus_plan_fusion_*``, ISSUE 19).  Reads the
+        process-global registry, so
         pipeline joins that never touch a server still show up on the
         scrape.  A label may carry either counter family or both
         (routing counters land per partitioned probe, multiway counters
@@ -880,6 +882,27 @@ class TelemetryPlane:
                         "counter", tags,
                         c.get("multiway_intermediate_rows_avoided", 0),
                     )
+                )
+            if "fused_probes" in c:
+                # the fused probe pass's engagement evidence (ISSUE 19):
+                # executions, fact rows entering vs surviving the
+                # absorbed filters (the rows the fan-out never saw), and
+                # rows emitted
+                out.append(
+                    Sample("csvplus_plan_fusion_total", "counter",
+                           tags, c["fused_probes"])
+                )
+                out.append(
+                    Sample("csvplus_plan_fusion_rows_full_total", "counter",
+                           tags, c.get("fused_rows_full", 0))
+                )
+                out.append(
+                    Sample("csvplus_plan_fusion_rows_selected_total",
+                           "counter", tags, c.get("fused_rows_selected", 0))
+                )
+                out.append(
+                    Sample("csvplus_plan_fusion_rows_out_total", "counter",
+                           tags, c.get("fused_rows_out", 0))
                 )
         return out
 

@@ -910,9 +910,14 @@ def join_tables(
                 _exp["path"] = "unique-identity"
             elif maxc <= 1:
                 # unique but partial: compact the selection without the
-                # expansion scan; pow2-padded flatnonzero bounds recompiles
+                # expansion scan; pow2 padding bounds recompiles
                 padded = 1 << max(total - 1, 0).bit_length() if total else 1
-                sel = jnp.flatnonzero(counts > 0, size=padded, fill_value=0)
+                if _whole_device(lower, counts):
+                    sel = _host_compact_ids(np.asarray(counts) > 0, padded)
+                else:
+                    sel = jnp.flatnonzero(
+                        counts > 0, size=padded, fill_value=0
+                    )
                 probe_ids = sel[:total].astype(jnp.int32)
                 build_ids = jnp.take(lower, probe_ids, axis=0)
                 _exp["path"] = "unique-partial"
@@ -1076,6 +1081,47 @@ def _multiway_select_kernel(lowers, counts, padded: int):  # analysis: allow[JIT
     return sel, build
 
 
+def _whole_device(*arrays) -> bool:
+    """True when every probe answer sits whole on a single device — the
+    host compaction below reads them without a cross-device gather."""
+    for a in arrays:
+        sh = getattr(a, "sharding", None)
+        if sh is None or len(sh.device_set) != 1:
+            return False
+    return True
+
+
+def _host_compact_ids(mask_np, padded: int) -> jax.Array:
+    """Ascending ids of the set mask positions, zero-padded to *padded*.
+
+    The unique-partial compaction is one linear scan, but XLA lowers the
+    flatnonzero form to cumsum + scatter and the host backend serializes
+    the scatter (~45ms per million rows — it dominated both macro-bench
+    legs).  The fast-path decision has already paid a stats sync, so the
+    mask costs one transfer: numpy scans it and only the padded id
+    vector ships back.  Bitwise-identical to the device kernel."""
+    ids = np.zeros(padded, dtype=np.int32)
+    nz = np.flatnonzero(mask_np)
+    ids[: nz.shape[0]] = nz
+    return jnp.asarray(ids)
+
+
+def _compact_unique_partial(lowers, counts, padded: int):
+    """(probe_ids, per-dim build_ids) for the multiway unique-partial
+    shape — host compaction when the answers allow it (see
+    ``_host_compact_ids``), the jitted select kernel otherwise."""
+    if _whole_device(*lowers, *counts):
+        mask = np.asarray(counts[0]) > 0
+        for ct in counts[1:]:
+            mask &= np.asarray(ct) > 0
+        sel = _host_compact_ids(mask, padded)
+        build = tuple(
+            jnp.take(lo.astype(jnp.int32), sel, axis=0) for lo in lowers
+        )
+        return sel, build
+    return _multiway_select_kernel(lowers, counts, padded)
+
+
 @register_kernel("join.multiway_expand")
 @_partial(jax.jit, static_argnames=("padded_total",))
 def _multiway_expand_kernel(lowers, counts, padded_total: int):  # analysis: allow[JIT001] retrace is per join ARITY, not per data length
@@ -1235,7 +1281,7 @@ def multiway_join(
                 _exp["path"] = "multiway-unique-identity"
             elif maxp <= 1:
                 padded = 1 << max(total - 1, 0).bit_length() if total else 1
-                probe_ids, build_ids = _multiway_select_kernel(
+                probe_ids, build_ids = _compact_unique_partial(
                     lowers, counts, padded
                 )
                 probe_ids = probe_ids[:total]
@@ -1333,6 +1379,210 @@ def multiway_join(
         len(specs), stream.nrows, n_out, inter,
     )
     return DeviceTable(cur, n_out, stream.device)
+
+
+# -- fused probe pass over a selection (ISSUE 19) ---------------------------
+#
+# ``multiway_join_selected`` is the probe half of the FusedProbe operator
+# (plan.py): the executor keeps the absorbed Filter/Map/projection run
+# lazy on its selection view and hands the SELECTION — not a
+# materialized table — straight to the probe.  Key columns gather down
+# to the selection only for probing (the same arrays the staged path
+# would have probed after ``materialize()``, so every probe answer is
+# identical); the emit gather then composes the selection into the
+# probe ids (``take(take(S, sel), ids) == take(S, take(sel, ids))``),
+# so the staged path's pre-join full-width materialize never happens
+# while values, row order, column order and merge semantics stay
+# bitwise the cascade's.  Unlike ``multiway_join``, a single spec does
+# NOT delegate to ``join_tables`` — the multiway kernels subsume the
+# binary paths exactly (one dimension's fan-out has suffix product 1,
+# so the mixed-radix offset IS ``_expand_kernel``'s run offset), and
+# one code path keeps the fused emit uniform over k.
+#
+# Caller contract: *sel* must be nonempty (the executor falls back to
+# the staged join for an empty selection — it hits the cascade's empty
+# folds exactly), and every spec's key columns must already be
+# validated over the selected rows (the executor's ``_check_key_cells``
+# raises the host-parity errors with scan-base-correct row numbers).
+
+
+@register_kernel("join.gather_fused_both")
+@jax.jit
+def _gather_fused_both(build_codes, stream_codes, build_ids, probe_ids, sel):  # analysis: allow[JIT001] — arity fixed per pipeline shape
+    """The fused-emit form of ``_gather_multiway_both``: stream columns
+    gather from FULL-length storage by the composed ``sel[probe_ids]``
+    index — gather associativity is the whole fusion win (one gather
+    instead of materialize-then-gather)."""
+    out_b = []
+    for codes, ids in zip(build_codes, build_ids):
+        idx = jnp.asarray(ids, dtype=jnp.int32)
+        out_b.append(tuple(jnp.take(c, idx, axis=0) for c in codes))
+    p_idx = jnp.asarray(probe_ids, dtype=jnp.int32)
+    e_idx = jnp.take(jnp.asarray(sel, dtype=jnp.int32), p_idx, axis=0)
+    return (
+        tuple(out_b),
+        tuple(jnp.take(c, e_idx, axis=0) for c in stream_codes),
+    )
+
+
+def multiway_join_selected(
+    cols,
+    sel,
+    device,
+    specs: "Sequence[Tuple[DeviceIndex, Sequence[str]]]",
+    identity: bool = False,
+) -> DeviceTable:
+    """selection(cols, sel) ⋈ index_1 ⋈ ... ⋈ index_k without ever
+    materializing the selected stream — bitwise-identical to
+    ``multiway_join(gather(cols, sel), specs)`` (and, for one spec, to
+    ``join_tables``).  *cols* maps names to FULL-length columns, *sel*
+    is the selected row-id array, *identity* asserts sel is the whole
+    range in order (then per-column gathers pass through, exactly like
+    ``materialize()``'s identity fast path)."""
+    from ..columnar.table import merge_with_fallback
+    from ..obs.joinskew import joinskew
+    from ..utils.observe import telemetry
+
+    n_sel = int(sel.shape[0])
+
+    # every dimension probes the SELECTED key values: the same arrays a
+    # staged materialize would have produced, so probe answers (and the
+    # shared partitioned-tier state threading) match the staged run
+    part_info: dict = {}
+    answers = []
+    for dev_index, kcols in specs:
+        probe_cols = [
+            cols[c] if identity else cols[c].gather(sel) for c in kcols
+        ]
+        answers.append(dev_index.probe(probe_cols, n_sel, part_info=part_info))
+    lowers = tuple(lo for lo, _ in answers)
+    counts = tuple(ct for _, ct in answers)
+
+    probe_ids = None
+    inter = 0
+    with telemetry.stage("join:expand", n_sel) as _exp:
+        _exp["dims"] = len(specs)
+        if all(isinstance(lo, jax.Array) for lo in lowers):
+            total, maxp, inter = (
+                int(v) for v in np.asarray(_multiway_stats(counts))
+            )
+            if maxp <= 1 and total == n_sel:
+                build_ids = lowers
+                _exp["path"] = "fused-unique-identity"
+            elif maxp <= 1:
+                padded = 1 << max(total - 1, 0).bit_length() if total else 1
+                probe_ids, build_ids = _compact_unique_partial(
+                    lowers, counts, padded
+                )
+                probe_ids = probe_ids[:total]
+                build_ids = tuple(b[:total] for b in build_ids)
+                _exp["path"] = "fused-unique-partial"
+            else:
+                padded = 1 << max(total - 1, 0).bit_length() if total else 1
+                probe_ids, build_ids = _multiway_expand_kernel(
+                    lowers, counts, padded
+                )
+                probe_ids = probe_ids[:total]
+                build_ids = tuple(b[:total] for b in build_ids)
+                _exp["path"] = "fused-fan-out"
+        else:  # a host-answering tier: expand in numpy
+            probe_ids, build_ids, total, inter = _multiway_expand_host(
+                lowers, counts
+            )
+            _exp["path"] = "fused-host-expand"
+        _exp["rows_out"] = total
+        telemetry.barrier((probe_ids,) + tuple(build_ids))
+
+    build_names = [list(di.table.columns) for di, _ in specs]
+    build_codes = tuple(
+        tuple(
+            _aligned_codes(di, n, di.table.columns[n].storage, bid)
+            for n in names
+        )
+        for (di, _), names, bid in zip(specs, build_names, build_ids)
+    )
+    stream_names = list(cols)
+    stream_codes = tuple(cols[n].storage for n in stream_names)
+    flat_build = tuple(c for side in build_codes for c in side)
+
+    with telemetry.stage("join:merge", n_sel) as _mrg:
+        if probe_ids is None:
+            # every selected row matched once per dimension: the stream
+            # side IS the selection — the one gather the staged
+            # materialize would have paid anyway (identity: none at all)
+            if same_placement(flat_build + tuple(build_ids)):
+                g_build = _gather_multiway(build_codes, build_ids)
+            else:
+                g_build = tuple(
+                    tuple(
+                        jnp.take(c, jnp.asarray(b, dtype=jnp.int32), axis=0)
+                        for c in side
+                    )
+                    for side, b in zip(build_codes, build_ids)
+                )
+            if identity:
+                g_stream = None
+            elif same_placement(stream_codes + (sel,)):
+                g_stream = _gather_cols(stream_codes, sel)
+            else:
+                s_idx = jnp.asarray(sel, dtype=jnp.int32)
+                g_stream = tuple(
+                    jnp.take(c, s_idx, axis=0) for c in stream_codes
+                )
+            n_out = n_sel
+        elif same_placement(flat_build + stream_codes):
+            # the fused win: ONE composed gather from full-length
+            # storage replaces materialize-then-gather
+            g_build, g_stream = _gather_fused_both(
+                build_codes, stream_codes, build_ids, probe_ids, sel
+            )
+            n_out = total
+        else:
+            # mixed placements: compose the index eagerly, then eager
+            # per-column takes (the host-expand tier lands here)
+            e_idx = jnp.take(
+                jnp.asarray(sel, dtype=jnp.int32),
+                jnp.asarray(probe_ids, dtype=jnp.int32),
+                axis=0,
+            )
+            g_build = tuple(
+                tuple(
+                    jnp.take(c, jnp.asarray(b, dtype=jnp.int32), axis=0)
+                    for c in side
+                )
+                for side, b in zip(build_codes, build_ids)
+            )
+            g_stream = tuple(
+                jnp.take(c, e_idx, axis=0) for c in stream_codes
+            )
+            n_out = total
+
+        # the cascade's merge fold, verbatim from ``multiway_join``
+        if g_stream is None:
+            cur = dict(cols)
+        else:
+            cur = {
+                name: cols[name].with_storage(g)
+                for name, g in zip(stream_names, g_stream)
+            }
+        for (di, _), names, gathered in zip(specs, build_names, g_build):
+            new = {}
+            for name, g in zip(names, gathered):
+                new[name] = di.table.columns[name].with_storage(g)
+            for name, col in cur.items():
+                if name in new:
+                    col = merge_with_fallback(col, new[name])
+                new[name] = col
+            cur = new
+        _mrg["rows_out"] = n_out
+        telemetry.barrier(tuple(c.storage for c in cur.values()))
+
+    if len(specs) >= 2:  # counter parity: the staged binary join never ticks
+        joinskew.on_multiway(
+            "+".join(",".join(di.key_columns) for di, _ in specs),
+            len(specs), n_sel, n_out, inter,
+        )
+    return DeviceTable(cur, n_out, device)
 
 
 def except_mask(

@@ -619,7 +619,15 @@ def _detect_hot(qk_dev, n_shards: int, wide: bool):
 
     Returns ``(hot, hot_share)``: sorted distinct hot values as int64
     (wide) / int32 or None, plus the hot keys' aggregate share of the
-    sample — the planner's capacity hint for the tail exchange."""
+    sample — the planner's capacity hint for the tail exchange.
+
+    Fused probe passes (ISSUE 19) need no special handling here: the
+    sample is drawn from whatever packed key array reaches the
+    partitioned probe, and ``multiway_join_selected`` packs keys
+    gathered down to the POST-filter selection — so hot-key detection
+    and broadcast routing automatically see only the fact rows that
+    survived the absorbed filters, exactly the rows the exchange would
+    carry."""
     from ..obs.sketch import SpaceSaving
     from ..utils.observe import telemetry
 

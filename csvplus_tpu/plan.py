@@ -199,6 +199,55 @@ class MultiwayJoin(PlanNode):
         return f"MultiwayJoin({keys}) <- {self.child!r}"
 
 
+@dataclass(frozen=True)
+class FusedProbe(PlanNode):
+    """Fused physical operator for a licensed Filter/Map/projection run
+    ending in a probe (ISSUE 19): the row-linear ``ops`` evaluate
+    against the executor's lazy selection view and the join(s) then
+    probe the SELECTED rows directly — the pre-join ``materialize()``
+    (a full-width gather of every live column down to the selection)
+    never happens, and the emit gather composes the selection into the
+    probe ids instead (``take(take(S, sel), ids) == take(S, take(sel,
+    ids))``, so the result is bitwise the staged chain's).
+
+    ``ops`` is a tuple of data-only ``(kind, payload)`` pairs —
+    ``("filter", pred)``, ``("map", expr)``, ``("select", columns)``,
+    ``("drop", columns)`` — in original chain order; ``joins`` mirrors
+    :class:`MultiwayJoin`'s ``(index, key columns)`` pairs (one pair =
+    a fused binary join).  Never built by user combinators: only the
+    rewriter emits it, behind the per-placement fusion pricing rule
+    (``analysis/cost.py choose_fusion``) and the provenance license
+    that every absorbed op is row-linear with a known footprint."""
+
+    child: PlanNode
+    ops: Tuple[Tuple[str, Any], ...]
+    joins: Tuple[Tuple[Any, Tuple[str, ...]], ...]
+
+    def __repr__(self) -> str:
+        kinds = [k for k, _ in self.ops]
+        keys = [list(cols) for _, cols in self.joins]
+        return f"FusedProbe({kinds} -> {keys}) <- {self.child!r}"
+
+
+def fused_op_node(kind: str, payload: Any) -> Optional[PlanNode]:
+    """The equivalent standalone stage for one :class:`FusedProbe` op
+    entry, with ``child=None`` (never traversed).  Shared by the
+    provenance and verifier transfer functions so the fused stage's
+    abstract semantics are BY CONSTRUCTION the composition of the
+    staged ops it absorbed — the two analyses can never model an
+    absorbed op differently from its standalone form.  Returns ``None``
+    for an unknown kind (total barrier for the caller)."""
+    if kind == "filter":
+        return Filter(None, payload)
+    if kind == "map":
+        return MapExpr(None, payload)
+    if kind == "select":
+        return SelectCols(None, tuple(payload))
+    if kind == "drop":
+        return DropCols(None, tuple(payload))
+    return None
+
+
 def _is_symbolic(obj: Any) -> bool:
     """A stage argument is symbolic when it opts in via ``__plan_expr__``.
 

@@ -132,6 +132,28 @@ def _node_sig(node: P.PlanNode) -> Tuple:
                 )
             dims.append((tuple(columns), tuple(impl.columns), build_sig))
         return (t, tuple(dims))
+    if isinstance(node, P.FusedProbe):
+        # Also rewriter-only (ISSUE 19), but keep the key total: the
+        # absorbed ops contribute their value-bearing reprs (matching
+        # the standalone Filter/MapExpr/SelectCols/DropCols signatures)
+        # and the probe dimensions sign like MultiwayJoin's.
+        ops = tuple(
+            (kind, repr(payload) if kind in ("filter", "map")
+             else tuple(payload))
+            for kind, payload in node.ops
+        )
+        dims = []
+        for index, columns in node.joins:
+            impl = getattr(index, "_impl", index)
+            build = getattr(impl, "dev", None)
+            build_sig = None
+            if build is not None:
+                build_sig = (
+                    tuple(build.key_columns),
+                    _schema_sig(build.table),
+                )
+            dims.append((tuple(columns), tuple(impl.columns), build_sig))
+        return (t, ops, tuple(dims))
     # future node kinds degrade to type-only — a coarser key can only
     # cause false misses, never false hits across different op types
     return (t,)
@@ -209,6 +231,12 @@ class PlanCache:
         # cost-chosen join-order permutation / a fused MultiwayJoin.
         self.reordered = 0
         self.fused = 0
+        # ISSUE 19 attribution: shapes whose recipe fused a Filter/Map/
+        # projection run into the probe pass (FusedProbe), and shapes
+        # where the rewriter CONSIDERED fusing but the pricing rule or
+        # an opaque op refused (a "probe-fuse" blocked diagnostic).
+        self.fused_chains = 0
+        self.fusion_refused = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -238,12 +266,16 @@ class PlanCache:
                 self.rejected += 1
             raise PlanRejected(report.errors)
         recipe = None
+        fusion_refused_flag = False
         from ..analysis.rewrite import optimize_enabled, optimize_plan
 
         if optimize_enabled():
             try:
                 result = optimize_plan(root, report)
                 recipe = result.recipe
+                fusion_refused_flag = any(
+                    d.rule == "probe-fuse" for d in result.blocked
+                )
             except Exception:
                 # The rewriter is advisory: a prover bug (verdict
                 # mismatch, unexpected node) must never cost an
@@ -258,12 +290,18 @@ class PlanCache:
             if existing is not None:
                 return existing  # racing insert won; reuse it
             self.lowered += 1
+            if fusion_refused_flag:
+                # refusals can exist with no recipe at all (nothing else
+                # applied): count them independent of recipe presence
+                self.fusion_refused += 1
             if recipe is not None:
                 self.optimized += 1
                 if getattr(recipe, "join_order", ()):
                     self.reordered += 1
                 if any(s[0] == "fuse_joins" for s in recipe.steps):
                     self.fused += 1
+                if any(s[0] == "fuse_chain" for s in recipe.steps):
+                    self.fused_chains += 1
             self._entries[key] = exe
             while len(self._entries) > self.size:
                 self._entries.popitem(last=False)
@@ -291,5 +329,7 @@ class PlanCache:
                 "optimize_failed": self.optimize_failed,
                 "reordered": self.reordered,
                 "fused": self.fused,
+                "fused_chains": self.fused_chains,
+                "fusion_refused": self.fusion_refused,
                 "hit_rate": round(self.hits / total, 4) if total else None,
             }

@@ -715,6 +715,69 @@ def test_multiway_fuse_fixed_examples_match_cascade_and_host():
     _multiway_differential(d1, d2, [])
 
 
+def _probe_fuse_differential(dim_rows, stream_rows, pred):
+    """Filter -> Map -> Join served through the plan cache with probe
+    fusion enabled vs the CSVPLUS_FUSE=0 staged chain (bitwise) vs the
+    host executor (row-identical) — ISSUE 19's parity contract."""
+    import os
+
+    from csvplus_tpu.serve import PlanCache
+    from csvplus_tpu.utils.checksum import checksum_device_table
+
+    idx = TakeRows(dim_rows).index_on("a")
+    host = (
+        TakeRows(stream_rows)
+        .filter(pred)
+        .map(SetValue("flag", "F"))
+        .join(idx, "a")
+        .to_rows()
+    )
+    idx.on_device("cpu")
+    plan = (
+        source_from_table(DeviceTable.from_rows(stream_rows, device="cpu"))
+        .filter(pred)
+        .map(SetValue("flag", "F"))
+        .join(idx, "a")
+        .plan
+    )
+    prev = os.environ.get("CSVPLUS_FUSE")
+    try:
+        os.environ["CSVPLUS_FUSE"] = "0"
+        staged = PlanCache(size=4).execute(plan)
+        os.environ.pop("CSVPLUS_FUSE")
+        cache = PlanCache(size=4)
+        fused = cache.execute(plan)
+    finally:
+        if prev is None:
+            os.environ.pop("CSVPLUS_FUSE", None)
+        else:
+            os.environ["CSVPLUS_FUSE"] = prev
+    assert fused.nrows == staged.nrows == len(host)
+    assert list(fused.columns) == list(staged.columns)
+    assert checksum_device_table(fused, positional=True) == (
+        checksum_device_table(staged, positional=True)
+    )
+    assert fused.to_rows() == host
+    return cache.stats()
+
+
+def test_probe_fuse_fixed_examples_match_staged_and_host():
+    """Deterministic probe-fusion differentials (ISSUE 19, run even
+    without hypothesis): duplicate build keys under the filter's
+    selection, a filter selecting zero rows, and the empty stream —
+    fused == staged bitwise == host rows, with the fuse counted by the
+    serving cache."""
+    dim = [Row({"a": "x", "d": "d0"}), Row({"a": "x", "d": "d1"}),
+           Row({"a": "y", "d": "d2"})]
+    stream = [Row({"a": "x", "b": "s0"}), Row({"a": "y", "b": "s1"}),
+              Row({"a": "zz", "b": "s2"}), Row({"a": "x", "b": "s3"})]
+    st = _probe_fuse_differential(dim, stream, Like({"a": "x"}))
+    assert st["fused_chains"] == 1
+    # filter selects zero rows; then the empty stream
+    _probe_fuse_differential(dim, stream, Like({"a": "never"}))
+    _probe_fuse_differential(dim, [], Like({"a": "x"}))
+
+
 @given(
     tables(min_rows=1, max_rows=16),
     tables(min_rows=1, max_rows=16),

@@ -86,8 +86,12 @@ def test_predicate_pushdown_past_map_and_join_bitwise():
     )
     result = optimize_plan(plan)
     assert any(r.startswith("predicate-pushdown") for r in result.applied)
-    # the filter crossed both the Join and the Map, down to the leaf
-    assert _chain_ops(result.root)[:2] == ["Scan", "Filter"]
+    # the filter crossed both the Join and the Map, down to the leaf —
+    # where pass 5 absorbs the whole Filter->Map->Join run into the
+    # probe pass, filter first (i.e. BEFORE the fanout)
+    chain = P.linearize(result.root)
+    assert _chain_ops(result.root) == ["Scan", "FusedProbe"]
+    assert chain[1].ops[0][0] == "filter"
     # crossing the may-error Join consumed a presence fact -> obligation
     assert "id" in result.recipe.require_present
     _bitwise_equal(_run(plan), _run(result.root))
@@ -150,8 +154,8 @@ def test_all_three_rules_compose_bitwise():
     )
     result = optimize_plan(plan)
     rules = {r.split(":")[0] for r in result.applied}
-    assert rules == {"predicate-pushdown", "filter-reorder",
-                     "projection-pushdown"}
+    assert {"predicate-pushdown", "filter-reorder",
+            "projection-pushdown"} <= rules
     _bitwise_equal(_run(plan), _run(result.root))
 
 
@@ -315,17 +319,20 @@ def test_rank_join_orders_marks_submitted_and_provable():
 # -- the verdict assertion ---------------------------------------------
 
 
-def test_rewritten_plan_reverified_same_verdict():
+def test_rewritten_plan_reverified_same_verdict(monkeypatch):
     plan = _served_shape(_fact())
     result = optimize_plan(plan)
     assert result.recipe is not None
     assert result.report.ok == result.original_report.ok
     assert (result.report.predicts_empty
             == result.original_report.predicts_empty)
-    # and the rewritten chain is a permutation + one DropCols insert of
-    # the original (no stage invented, none lost)
+    # with probe fusion off, the rewritten chain is a permutation + one
+    # DropCols insert of the original (no stage invented, none lost)
+    monkeypatch.setenv("CSVPLUS_FUSE", "0")
+    staged = optimize_plan(plan)
+    assert staged.report.ok == staged.original_report.ok
     orig = sorted(_chain_ops(plan))
-    new = sorted(_chain_ops(result.root))
+    new = sorted(_chain_ops(staged.root))
     assert [op for op in new if op != "DropCols"] == orig
 
 
@@ -412,6 +419,127 @@ def test_multiway_disabled_hatch(monkeypatch):
     assert not any(s[0] == "fuse_joins" for s in steps)
     assert _chain_ops(result.root).count("Join") == 2
     _bitwise_equal(_run(plan), _run(result.root))
+
+
+# -- ISSUE 19: filter/map/projection fused into the probe pass ---------
+
+
+def _zipf_fact(n=N, s=1.1, seed=7):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    ids = rng.zipf(s, size=n) % 50
+    return DeviceTable.from_pylists(
+        {"id": [str(int(i)) for i in ids],
+         "cat": [f"k{i % 8}" for i in range(n)],
+         "pad1": [str(i) for i in range(n)],
+         "pad2": ["p"] * n},
+        device="cpu",
+    )
+
+
+def _fused_shape(fact):
+    """Filter -> Map -> Join over *fact*: the canonical absorbable run."""
+    return P.Join(
+        P.MapExpr(
+            P.Filter(P.Scan(fact), Like({"cat": "k1"})),
+            SetValue("flag", "x"),
+        ),
+        _dim(),
+        ("id",),
+    )
+
+
+@pytest.mark.parametrize("fact_fn", [_fact, _zipf_fact],
+                         ids=["uniform", "zipf"])
+def test_probe_fuse_bitwise(fact_fn):
+    """The Filter->Map->Join run lowers into ONE FusedProbe node whose
+    execution is bitwise the staged chain's, on uniform AND Zipf-skewed
+    key distributions."""
+    plan = _fused_shape(fact_fn())
+    result = optimize_plan(plan)
+    assert any(r.startswith("probe-fuse") for r in result.applied)
+    assert any(s[0] == "fuse_chain" for s in result.recipe.steps)
+    chain = P.linearize(result.root)
+    assert _chain_ops(result.root) == ["Scan", "FusedProbe"]
+    assert [k for k, _ in chain[1].ops] == ["filter", "map"]
+    _bitwise_equal(_run(plan), _run(result.root))
+
+
+def test_probe_fuse_partitioned_probe_bitwise(monkeypatch):
+    """With the partition threshold floored the fused probe runs through
+    the partitioned exchange tier (K=8 shards' worth of keys instead of
+    the dense single-shard tier) and stays bitwise-identical."""
+    import csvplus_tpu.ops.join as J
+
+    monkeypatch.setattr(J.DeviceIndex, "PARTITION_MIN_KEYS", 1)
+    plan = _fused_shape(_zipf_fact())
+    result = optimize_plan(plan)
+    assert _chain_ops(result.root) == ["Scan", "FusedProbe"]
+    _bitwise_equal(_run(plan), _run(result.root))
+
+
+def test_probe_fuse_empty_fact_and_zero_selection():
+    """Degenerate selections: an EMPTY fact table, and a filter that
+    selects ZERO rows — both take the staged empty-fold path inside the
+    fused branch and answer bitwise-identically."""
+    empty = DeviceTable.from_pylists(
+        {"id": [], "cat": [], "pad1": [], "pad2": []}, device="cpu")
+    for fact, pred in ((empty, Like({"cat": "k1"})),
+                       (_fact(), Like({"cat": "nope"}))):
+        plan = P.Join(P.Filter(P.Scan(fact), pred), _dim(), ("id",))
+        result = optimize_plan(plan)
+        staged, fused = _run(plan), _run(result.root)
+        assert staged.nrows == fused.nrows == 0
+        _bitwise_equal(staged, fused)
+
+
+def test_probe_fuse_opaque_predicate_refused():
+    """An opaque predicate (no static column footprint) bounds the
+    absorbable run: the rewriter refuses with a typed probe-fuse
+    diagnostic instead of fusing blind."""
+
+    class Opaque:  # not a Like/All/Any/Not tree -> no lowering
+        pass
+
+    plan = P.Join(P.Filter(P.Scan(_fact()), Opaque()), _dim(), ("id",))
+    result = optimize_plan(plan)
+    assert not any(r.startswith("probe-fuse") for r in result.applied)
+    block = [d for d in result.blocked if d.rule == "probe-fuse"]
+    assert block and "opaque" in block[0].message
+
+
+def test_probe_fuse_disabled_hatch(monkeypatch):
+    """CSVPLUS_FUSE=0: the same chain keeps its staged shape (no
+    fuse_chain step, Filter and Join both live) and answers
+    byte-identically to the unrewritten plan."""
+    monkeypatch.setenv("CSVPLUS_FUSE", "0")
+    plan = _fused_shape(_fact())
+    result = optimize_plan(plan)
+    assert not any(r.startswith("probe-fuse") for r in result.applied)
+    steps = result.recipe.steps if result.recipe else ()
+    assert not any(s[0] == "fuse_chain" for s in steps)
+    assert "FusedProbe" not in _chain_ops(result.root)
+    _bitwise_equal(_run(plan), _run(result.root))
+
+
+def test_probe_fuse_plancache_counted_and_zero_recompiles():
+    """The serving cache replays the fuse_chain recipe step under the
+    ORIGINAL structural key, counts the fused admission, and the warm
+    path recompiles nothing."""
+    from csvplus_tpu.obs.recompile import RecompileWatch
+
+    cache = PlanCache(size=8)
+    tables = [_fact(n=256) for _ in range(3)]
+    got = cache.execute(_fused_shape(tables[0]))
+    st = cache.stats()
+    assert st["fused_chains"] == 1 and st["fusion_refused"] == 0
+    _bitwise_equal(got, _run(_fused_shape(tables[0])))
+    with RecompileWatch() as watch:
+        for t in tables[1:]:
+            cache.execute(_fused_shape(t))
+    watch.assert_zero("warm fused serving")
+    assert cache.stats()["lowered"] == 1
 
 
 def test_multiway_fuse_blocked_on_unstable_key():
