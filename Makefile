@@ -1,7 +1,7 @@
 # Common workflows.  The test harness self-configures a hermetic 8-device
 # CPU mesh regardless of the environment (see tests/conftest.py).
 
-.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal bench-view bench-opt bench-macro trace-smoke obs-smoke skew-smoke multiway-smoke fuse-smoke chaos check dryrun example coldcheck lint analyze asan
+.PHONY: test soak bench bench-micro bench-mesh bench-ingest bench-serve bench-delta bench-wal bench-view bench-opt bench-macro trace-smoke obs-smoke skew-smoke multiway-smoke fuse-smoke chaos check dryrun example coldcheck lint analyze plan-cert asan
 
 test:
 	python -m pytest tests/ -x -q
@@ -10,7 +10,7 @@ test:
 # differential, mutable-index storage bench, materialized-view bench,
 # telemetry-plane smoke, skew-aware-join smoke — the set a change must
 # keep green before review.
-check: test lint chaos bench-delta bench-wal bench-view bench-opt obs-smoke skew-smoke multiway-smoke fuse-smoke
+check: test lint plan-cert chaos bench-delta bench-wal bench-view bench-opt obs-smoke skew-smoke multiway-smoke fuse-smoke
 
 # Static analysis gate (docs/ANALYSIS.md).  The repo AST lint (ctypes
 # boundary + jit retrace rules) always runs; ruff and mypy run when
@@ -35,6 +35,16 @@ lint:
 analyze: lint
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m csvplus_tpu.analysis --json --snapshot tests/data/analyze_snapshot.json >/dev/null
+
+# Exhaustive plan-space rewrite certification (docs/ANALYSIS.md, ISSUE
+# 20): enumerate EVERY plan chain up to CSVPLUS_PLANCERT_N (default 3;
+# a few hundred plans) over the canonical corpus, verify -> optimize
+# each, and discharge the four obligations — verdict equality, licensed
+# recipe steps, bitwise execution parity, real refusal stages.  Exits
+# nonzero on any failed obligation or when the run exceeds
+# CSVPLUS_PLANCERT_BUDGET_S (default 60s) — the make check budget.
+plan-cert:
+	JAX_PLATFORMS=cpu python -m csvplus_tpu.analysis plan-cert
 
 # Native scanner under AddressSanitizer + UBSan: rebuilds scanner.cpp
 # with -fsanitize into a separate artifact (CSVPLUS_NATIVE_SO, so the

@@ -22,6 +22,23 @@
     rewrite decision for the named example chains (all of them with no
     names; ``--list`` prints the names) — the same fixed-width-table
     CLI shape as ``obs diff``.  Unknown names exit 2.
+
+``python -m csvplus_tpu.analysis lint [--json] [paths...]``
+    Explicit lint entry point: same behavior as the bare invocation but
+    with a ``--json`` mode that prints just the findings list (the
+    lint slice of the full payload) for diffable lint snapshots.
+
+``python -m csvplus_tpu.analysis env [--write FILE]``
+    Render the environment-variable registry (utils/env.py) as the
+    docs/ENV.md table; ``--write`` regenerates the committed file the
+    ENV001-R lint checks for drift.
+
+``python -m csvplus_tpu.analysis plan-cert [--json]``
+    Exhaustively certify the plan space up to ``CSVPLUS_PLANCERT_N``
+    (see analysis/plancert.py: verdict equality, licensed recipe
+    steps, bitwise execution parity, real refusal stages).  Exit 1 if
+    any obligation fails or the wall-clock budget is exceeded — the
+    ``make plan-cert`` contract.
 """
 
 from __future__ import annotations
@@ -71,10 +88,68 @@ def _explain(args) -> int:
     return 0
 
 
+def _lint(args, as_json: bool) -> int:
+    paths = args or None
+    if as_json:
+        from .report import lint_json
+
+        findings = lint_json(paths)
+        print(json.dumps(findings, indent=2, sort_keys=True))
+        return 1 if findings else 0
+    from .astlint import lint_paths
+    from .report import default_lint_paths
+
+    findings = lint_paths(
+        paths if paths is not None else default_lint_paths(),
+        global_checks=paths is None,
+    )
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+def _env(args) -> int:
+    from ..utils.env import render_env_md
+
+    text = render_env_md()
+    if "--write" in args:
+        i = args.index("--write")
+        target = args[i + 1]
+        with open(target, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"wrote {target}", file=sys.stderr)
+        return 0
+    print(text, end="")
+    return 0
+
+
+def _plan_cert(args) -> int:
+    from .plancert import certify, summary_json
+
+    summary = certify()
+    if "--json" in args:
+        print(json.dumps(summary_json(summary), indent=2, sort_keys=True))
+    else:
+        print(summary.describe())
+    return 0 if summary.ok else 1
+
+
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     if args and args[0] == "explain":
         return _explain(args[1:])
+    if args and args[0] == "lint":
+        rest = args[1:]
+        as_json = "--json" in rest
+        if as_json:
+            rest.remove("--json")
+        return _lint(rest, as_json)
+    if args and args[0] == "env":
+        return _env(args[1:])
+    if args and args[0] == "plan-cert":
+        return _plan_cert(args[1:])
     as_json = "--json" in args
     if as_json:
         args.remove("--json")
@@ -93,7 +168,10 @@ def main(argv=None) -> int:
         from .astlint import lint_paths
         from .report import default_lint_paths
 
-        findings = lint_paths(paths if paths is not None else default_lint_paths())
+        findings = lint_paths(
+            paths if paths is not None else default_lint_paths(),
+            global_checks=paths is None,
+        )
         for f in findings:
             print(f)
         if findings:

@@ -1184,6 +1184,154 @@ def _lock_findings(tree: ast.Module, path: str) -> List[LintFinding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# ENV001-R — the configuration registry boundary (ISSUE 20).  Every
+# ``os.environ`` read routes through utils/env.py's registered
+# accessors, every variable they read is declared in ENV_REGISTRY, and
+# the generated docs/ENV.md matches the registry byte-for-byte.  A knob
+# that exists only at its read site is invisible to operators and to
+# the obs-diff lint snapshots; ~25 CSVPLUS_* vars had scattered reads
+# before the registry landed.
+# ---------------------------------------------------------------------------
+
+_ENV_ACCESSORS = frozenset({"env_int", "env_str", "env_float"})
+
+
+def _env_registry_names() -> Optional[frozenset]:
+    """Registered variable names from the live registry module, or None
+    when it cannot be imported (linting outside the package)."""
+    try:
+        from ..utils.env import ENV_REGISTRY
+    except Exception:
+        return None
+    return frozenset(ENV_REGISTRY)
+
+
+def _env_findings(tree: ast.Module, path: str) -> List[LintFinding]:
+    """ENV001-R per-file half: direct ``os.environ``/``os.getenv`` reads
+    outside utils/env.py, and accessor calls naming an unregistered (or
+    non-literal) variable."""
+    p = Path(path)
+    if p.name == "env.py" and "utils" in p.parts:
+        return []  # the one sanctioned os.environ reader
+    findings: List[LintFinding] = []
+    registry = _env_registry_names()
+    direct_lines: Set[int] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in ("environ", "getenv")
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os"
+        ):
+            if node.lineno not in direct_lines:
+                direct_lines.add(node.lineno)
+                findings.append(
+                    LintFinding(
+                        "ENV001-R",
+                        path,
+                        node.lineno,
+                        f"direct os.{node.attr} read — route through the "
+                        "utils/env.py accessors (env_str/env_int/"
+                        "env_float) so the variable lands in ENV_REGISTRY "
+                        "and docs/ENV.md",
+                    )
+                )
+        elif isinstance(node, ast.Call):
+            f = node.func
+            fname = None
+            if isinstance(f, ast.Name):
+                fname = f.id.lstrip("_")
+            elif isinstance(f, ast.Attribute):
+                fname = f.attr.lstrip("_")
+            if fname not in _ENV_ACCESSORS or not node.args:
+                continue
+            first = node.args[0]
+            if not (
+                isinstance(first, ast.Constant) and isinstance(first.value, str)
+            ):
+                findings.append(
+                    LintFinding(
+                        "ENV001-R",
+                        path,
+                        node.lineno,
+                        f"{fname}(...) takes a computed variable name — "
+                        "names must be string literals so registration "
+                        "is statically checkable",
+                    )
+                )
+            elif registry is not None and first.value not in registry:
+                findings.append(
+                    LintFinding(
+                        "ENV001-R",
+                        path,
+                        node.lineno,
+                        f"{fname}({first.value!r}) reads a variable not "
+                        "declared in utils/env.py ENV_REGISTRY — register "
+                        "it (name, kind, default, description)",
+                    )
+                )
+    return findings
+
+
+def env_global_findings() -> List[LintFinding]:
+    """ENV001-R whole-tree half, run once per lint invocation over the
+    installed package: stale registry entries (declared but read
+    nowhere) and generated-doc drift (committed docs/ENV.md differs
+    from ``render_env_md()``)."""
+    try:
+        from ..utils import env as env_mod
+    except Exception:
+        return []
+    pkg = Path(__file__).resolve().parent.parent
+    reg_path = pkg / "utils" / "env.py"
+    findings: List[LintFinding] = []
+    sources = [
+        f.read_text(encoding="utf-8")
+        for f in sorted(pkg.rglob("*.py"))
+        if f != reg_path
+    ]
+    for name in env_mod.ENV_REGISTRY:
+        quoted = (f'"{name}"', f"'{name}'")
+        if not any(q in src for src in sources for q in quoted):
+            findings.append(
+                LintFinding(
+                    "ENV001-R",
+                    str(reg_path),
+                    1,
+                    f"ENV_REGISTRY entry {name} is read nowhere in the "
+                    "package — registry drift (remove it or wire the "
+                    "read through an accessor)",
+                )
+            )
+    docs = pkg.parent / "docs" / "ENV.md"
+    if docs.parent.is_dir():
+        rendered = env_mod.render_env_md()
+        if not docs.exists():
+            findings.append(
+                LintFinding(
+                    "ENV001-R",
+                    str(docs),
+                    1,
+                    "generated docs/ENV.md is missing — write it with "
+                    "`python -m csvplus_tpu.analysis env --write "
+                    "docs/ENV.md`",
+                )
+            )
+        elif docs.read_text(encoding="utf-8") != rendered:
+            findings.append(
+                LintFinding(
+                    "ENV001-R",
+                    str(docs),
+                    1,
+                    "docs/ENV.md drifted from utils/env.py ENV_REGISTRY "
+                    "— regenerate with `python -m csvplus_tpu.analysis "
+                    "env --write docs/ENV.md`",
+                )
+            )
+    return findings
+
+
 _BROAD_EXCEPT_NAMES = frozenset({"Exception", "BaseException"})
 
 
@@ -1264,8 +1412,15 @@ def _suppressed(finding: LintFinding, lines: List[str], tree: ast.Module) -> boo
     return False
 
 
-def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
-    """All unsuppressed findings for one module's source text."""
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    matched_out=None,
+) -> List[LintFinding]:
+    """All unsuppressed findings for one module's source text.
+    *matched_out* (a set, whole-tree lint only) accumulates the
+    jitlint allowlist keys this file's sync sites matched, feeding the
+    global staleness check."""
     tree = ast.parse(source, filename=path)
     findings: List[LintFinding] = []
     positions = _c_char_positions(tree)
@@ -1287,23 +1442,38 @@ def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
     findings.extend(_lock_findings(tree, path))
     findings.extend(_fault_findings(tree, path))
     findings.extend(_io_findings(tree, path))
+    findings.extend(_env_findings(tree, path))
+    from .jitlint import jitlint_findings  # late: jitlint imports us
+
+    findings.extend(jitlint_findings(tree, path, matched_out))
     lines = source.splitlines()
     findings = [f for f in findings if not _suppressed(f, lines, tree)]
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     return findings
 
 
-def lint_file(path) -> List[LintFinding]:
+def lint_file(path, matched_out=None) -> List[LintFinding]:
     p = Path(path)
-    return lint_source(p.read_text(encoding="utf-8"), str(p))
+    return lint_source(p.read_text(encoding="utf-8"), str(p), matched_out)
 
 
-def lint_paths(paths: Iterable) -> List[LintFinding]:
-    """Lint every ``.py`` file under each path (file or directory)."""
+def lint_paths(paths: Iterable, global_checks: bool = False) -> List[LintFinding]:
+    """Lint every ``.py`` file under each path (file or directory).
+    With *global_checks* (the whole-package lint run), the cross-file
+    checks run once on top: the ENV001-R registry/doc drift checks and
+    the jitlint allowlist staleness check (per-file lints cannot tell
+    a stale allowance from a site they are not looking at)."""
+    matched: set = set()
     findings: List[LintFinding] = []
     for path in paths:
         p = Path(path)
         files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
         for f in files:
-            findings.extend(lint_file(f))
+            findings.extend(lint_file(f, matched))
+    if global_checks:
+        from .jitlint import allowlist_global_findings
+
+        findings.extend(env_global_findings())
+        findings.extend(allowlist_global_findings(matched))
+        findings.sort(key=lambda f: (f.path, f.line, f.code))
     return findings

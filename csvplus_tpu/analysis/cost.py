@@ -92,27 +92,63 @@ def _distinct_of(col) -> int:
         return DEFAULT_DISTINCT
 
 
-def predicate_selectivity(pred, distinct: Dict[str, int]) -> float:
+def _match_share(col: str, value, distinct: Dict[str, int],
+                 sketches: Optional[Dict[str, Any]]) -> float:
+    """Pass fraction of ``col == value``.  When a live SpaceSaving
+    sketch exists under the single-column label (the r14/r15 build-side
+    sketches — ``offer_build_sample`` decodes single-column keys to the
+    raw values, so a ``Like`` literal looks up directly), use the
+    value's OBSERVED share: tracked values take ``count/observed``;
+    untracked ones split the residual tail uniformly over the remaining
+    distinct values.  No sketch or an empty one falls back to the
+    static uniform ``1/distinct`` guess (ROADMAP item 1: cost estimates
+    should consult workload evidence, not just metadata)."""
+    d = float(distinct.get(col, DEFAULT_DISTINCT))
+    sk = sketches.get(col) if sketches else None
+    observed = getattr(sk, "observed", 0) if sk is not None else 0
+    if observed <= 0:
+        return 1.0 / d
+    top = sk.topk()
+    for key, count, _err in top:
+        if key == value:
+            return count / observed
+    tail_share = max(0.0, 1.0 - sum(c for _, c, _ in top) / observed)
+    tail_keys = max(1, int(d) - len(top))
+    return tail_share / tail_keys
+
+
+def predicate_selectivity(
+    pred,
+    distinct: Dict[str, int],
+    sketches: Optional[Dict[str, Any]] = None,
+) -> float:
     """Estimated pass fraction of *pred* given per-column distinct
-    counts: a ``Like`` equality keeps ~1/distinct per referenced column;
-    ``All``/``Any``/``Not`` compose under independence."""
+    counts: a ``Like`` equality keeps the value's sketch-observed share
+    when a live single-column sketch covers it (:func:`_match_share`),
+    else ~1/distinct per referenced column; ``All``/``Any``/``Not``
+    compose under independence.  Advisory only — selectivity feeds the
+    rewriter's PRICING, never its licensing, so a wild estimate can
+    cost performance but not correctness."""
     if isinstance(pred, Like):
         s = 1.0
-        for col in pred.match:
-            s *= 1.0 / float(distinct.get(col, DEFAULT_DISTINCT))
+        for col, value in pred.match.items():
+            s *= _match_share(col, value, distinct, sketches)
         return max(MIN_SELECTIVITY, s)
     if isinstance(pred, All):
         s = 1.0
         for q in pred.preds:
-            s *= predicate_selectivity(q, distinct)
+            s *= predicate_selectivity(q, distinct, sketches)
         return max(MIN_SELECTIVITY, s)
     if isinstance(pred, Any_):
         miss = 1.0
         for q in pred.preds:
-            miss *= 1.0 - predicate_selectivity(q, distinct)
+            miss *= 1.0 - predicate_selectivity(q, distinct, sketches)
         return max(MIN_SELECTIVITY, 1.0 - miss)
     if isinstance(pred, Not):
-        return max(MIN_SELECTIVITY, 1.0 - predicate_selectivity(pred.pred, distinct))
+        return max(
+            MIN_SELECTIVITY,
+            1.0 - predicate_selectivity(pred.pred, distinct, sketches),
+        )
     return OPAQUE_SELECTIVITY
 
 
@@ -222,7 +258,7 @@ def estimate_plan(
         sel: Optional[float] = None
         note = ""
         if isinstance(node, P.Filter):
-            sel = predicate_selectivity(node.pred, distinct)
+            sel = predicate_selectivity(node.pred, distinct, sketches)
             rows *= sel
         elif isinstance(node, (P.TakeWhile, P.DropWhile)):
             sel = WHILE_SELECTIVITY
@@ -277,7 +313,7 @@ def estimate_plan(
             sels: List[float] = []
             for kind, payload in node.ops:
                 if kind == "filter":
-                    s = predicate_selectivity(payload, distinct)
+                    s = predicate_selectivity(payload, distinct, sketches)
                     sels.append(s)
                     rows *= s
             dim_notes = []

@@ -30,7 +30,7 @@ from typing import Dict, List, Optional
 from .astlint import lint_paths
 from .verify import PlanReport, verify_plan
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 _PACKAGE_DIR = Path(__file__).resolve().parent.parent
 _REPO_ROOT = _PACKAGE_DIR.parent
@@ -43,7 +43,14 @@ def default_lint_paths() -> List[Path]:
 
 
 def lint_json(paths: Optional[List] = None) -> List[dict]:
-    findings = lint_paths(paths if paths is not None else default_lint_paths())
+    # global checks (allowlist staleness, ENV registry drift) only make
+    # sense over the whole package tree — explicit path subsets would
+    # report spurious "stale allowlist entry" findings for files not
+    # being linted
+    findings = lint_paths(
+        paths if paths is not None else default_lint_paths(),
+        global_checks=paths is None,
+    )
     out = []
     for f in findings:
         p = Path(f.path)
@@ -396,6 +403,17 @@ def explain_text(name: str, root) -> str:
     return "\n".join(lines)
 
 
+def plancert_json() -> dict:
+    """A small-N plan-space certification summary for the payload:
+    deterministic counts only (no timing), at a fixed N=2 so the
+    snapshot stays cheap to regenerate — the full default-N sweep runs
+    as ``make plan-cert``.  The budget is pinned effectively-infinite
+    here because the payload must not depend on machine speed."""
+    from .plancert import certify, summary_json
+
+    return summary_json(certify(n=2, budget_s=1e9))
+
+
 def json_payload(paths: Optional[List] = None) -> dict:
     """The full ``--json`` CLI payload (see docs/ANALYSIS.md schema)."""
     plans = {}
@@ -407,4 +425,5 @@ def json_payload(paths: Optional[List] = None) -> dict:
         "schema": SCHEMA_VERSION,
         "lint": lint_json(paths),
         "plans": plans,
+        "plan_cert": plancert_json(),
     }

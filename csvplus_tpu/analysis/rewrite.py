@@ -77,12 +77,12 @@ the pre-optimizer behavior).
 from __future__ import annotations
 
 import dataclasses
-import os
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from .. import plan as P
 from ..errors import CsvPlusError
+from ..utils.env import env_str
 from . import provenance as PV
 from .provenance import ProvenanceDiagnostic, StageFacts
 from .schema import Presence
@@ -101,7 +101,7 @@ __all__ = [
 
 
 def optimize_enabled() -> bool:
-    return os.environ.get("CSVPLUS_OPTIMIZE", "1") != "0"
+    return env_str("CSVPLUS_OPTIMIZE", "1") != "0"
 
 
 def multiway_enabled() -> bool:
@@ -109,7 +109,7 @@ def multiway_enabled() -> bool:
     nested under the global ``CSVPLUS_OPTIMIZE`` switch — the bench's
     cascaded leg runs with the optimizer ON but the fuse OFF so both
     legs share every other rewrite."""
-    return optimize_enabled() and os.environ.get("CSVPLUS_MULTIWAY", "1") != "0"
+    return optimize_enabled() and env_str("CSVPLUS_MULTIWAY", "1") != "0"
 
 
 def fuse_enabled() -> bool:
@@ -117,7 +117,7 @@ def fuse_enabled() -> bool:
     nested under the global ``CSVPLUS_OPTIMIZE`` switch — the
     macro-bench's staged leg runs with the optimizer ON but fusion OFF
     so both legs share every other rewrite."""
-    return optimize_enabled() and os.environ.get("CSVPLUS_FUSE", "1") != "0"
+    return optimize_enabled() and env_str("CSVPLUS_FUSE", "1") != "0"
 
 
 class RewriteVerdictMismatch(CsvPlusError):

@@ -59,6 +59,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from .. import plan as P
 from ..exprs import Rename, SetValue, Update
 from ..predicates import All, Any_, Like, Not
+from ..utils.env import env_str
 from .schema import (
     PLACE_UNKNOWN,
     Card,
@@ -784,7 +785,7 @@ def verify_plan(
 
 
 def _verify_enabled() -> bool:
-    return os.environ.get("CSVPLUS_VERIFY", "1") != "0"
+    return env_str("CSVPLUS_VERIFY", "1") != "0"
 
 
 def verify_before_lower(root: P.PlanNode) -> "Optional[PlanReport]":

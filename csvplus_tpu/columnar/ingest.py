@@ -26,6 +26,7 @@ from .table import DeviceTable
 # shared with the native scanner (utils.env); the old name stays an
 # alias because tests and downstream callers patch ingest._env_int
 from ..utils.env import env_int as _env_int
+from ..utils.env import env_str as _env_str
 
 
 
@@ -884,16 +885,14 @@ def _device_parse_enabled() -> bool:
     dispatch round trips per column.  So when the measured link RTT
     exceeds ``CSVPLUS_DEVICE_PARSE_MAX_RTT_MS`` (default 20ms) the
     host-encode tiers take over unless the env flag forces otherwise."""
-    import os
-
-    flag = os.environ.get("CSVPLUS_DEVICE_PARSE")
+    flag = _env_str("CSVPLUS_DEVICE_PARSE")
     if flag is not None:
         return flag == "1"
     import jax
 
     if jax.default_backend() in ("cpu",):
         return False
-    v = os.environ.get("CSVPLUS_DEVICE_PARSE_MAX_RTT_MS")
+    v = _env_str("CSVPLUS_DEVICE_PARSE_MAX_RTT_MS")
     try:
         thresh = float(v) if v else _DEVICE_PARSE_MAX_RTT_MS
     except ValueError:

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from ..obs.recompile import register_kernel
 from ..row import Row
+from ..utils.env import env_int
 
 ABSENT = np.int32(-1)
 
@@ -887,11 +888,7 @@ class DeviceTable:
     MIRROR_LRU_ROWS_DEFAULT = 65536
 
     def _mirror_lru_cap(self) -> int:
-        return int(
-            os.environ.get(
-                "CSVPLUS_MIRROR_LRU_ROWS", str(self.MIRROR_LRU_ROWS_DEFAULT)
-            )
-        )
+        return env_int("CSVPLUS_MIRROR_LRU_ROWS", self.MIRROR_LRU_ROWS_DEFAULT)
 
     def rows_from_mirror_many(
         self, bounds: Sequence[Tuple[int, int]]

@@ -26,13 +26,14 @@ from ..csvio import ERR_BARE_QUOTE, ERR_FIELD_COUNT, ERR_QUOTE
 from ..errors import DataSourceError, map_error
 from ..resilience import faults
 from ..utils.env import env_int as _env_int
+from ..utils.env import env_str as _env_str
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "scanner.cpp")
 # CSVPLUS_NATIVE_SO picks an alternate artifact name so an instrumented
 # build (e.g. `make asan`) neither reuses nor clobbers the -O3 cache;
 # CSVPLUS_NATIVE_CFLAGS appends extra g++ flags (space-split) to it.
-_SO = os.path.join(_HERE, os.environ.get("CSVPLUS_NATIVE_SO", "_scanner.so"))
+_SO = os.path.join(_HERE, _env_str("CSVPLUS_NATIVE_SO", "_scanner.so"))
 _lock = threading.Lock()
 _lib = None
 
@@ -43,7 +44,7 @@ def _build() -> str:
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return _SO
     tmp = f"{_SO}.{os.getpid()}.tmp"  # per-process: no concurrent clobber
-    extra = os.environ.get("CSVPLUS_NATIVE_CFLAGS", "").split()
+    extra = (_env_str("CSVPLUS_NATIVE_CFLAGS", "") or "").split()
     try:
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
@@ -983,7 +984,7 @@ def read_encoded_columns_native(reader, path: str):
         _column_positions(data_counts, field_offset, header, rec_base, pad_allowed)
     )
 
-    typed_enabled = os.environ.get("CSVPLUS_TYPED_LANES", "1") != "0"
+    typed_enabled = _env_str("CSVPLUS_TYPED_LANES", "1") != "0"
 
     def enc_one(args):
         name, pos, ok = args
@@ -1068,7 +1069,7 @@ _STREAM_CHUNK_BYTES = 64 << 20
 
 
 def _stream_chunk_bytes() -> int:
-    v = os.environ.get("CSVPLUS_STREAM_CHUNK_BYTES")
+    v = _env_str("CSVPLUS_STREAM_CHUNK_BYTES")
     return int(v) if v else _STREAM_CHUNK_BYTES
 
 
@@ -1505,7 +1506,7 @@ def stream_encoded_chunks(
     if encoder is not None:
         k_workers = 1  # device-encode hook: one upload stream, stays inline
 
-    typed_enabled = os.environ.get("CSVPLUS_TYPED_LANES", "1") != "0"
+    typed_enabled = _env_str("CSVPLUS_TYPED_LANES", "1") != "0"
     next_record = 1  # absolute 1-based ordinal of the next record scanned
     typed_live: set = set()  # columns still typed, in FILE order
     _pc = time.perf_counter

@@ -35,6 +35,8 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..utils.env import env_str
+
 __all__ = ["FlightRecorder", "recorder", "note", "attach", "dump"]
 
 #: Ring capacity: enough to cover several seconds of dispatch cycles
@@ -48,7 +50,7 @@ DUMP_SCHEMA_VERSION = 1
 
 
 def _default_dir() -> str:
-    return os.environ.get("CSVPLUS_FLIGHT_DIR") or tempfile.gettempdir()
+    return env_str("CSVPLUS_FLIGHT_DIR") or tempfile.gettempdir()
 
 
 class FlightRecorder:

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 
 from ..columnar.table import DeviceTable, StringColumn, same_placement
 from ..obs.recompile import register_kernel
+from ..utils.env import env_int
 
 
 def _bits_for(n: int) -> int:
@@ -252,24 +253,20 @@ class DeviceIndex:
     # Packed-key universes up to 2^DIRECT_MAX_BITS get the dictionary-
     # direct probe table (2^23+1 int32 = 32MB of HBM at the cap); larger
     # universes binary-search the sorted keys as before.
-    DIRECT_MAX_BITS: ClassVar[int] = int(
-        os.environ.get("CSVPLUS_DIRECT_PROBE_MAX_BITS", 23)
-    )
+    DIRECT_MAX_BITS: ClassVar[int] = env_int("CSVPLUS_DIRECT_PROBE_MAX_BITS", 23)
 
     # Build sides with at least this many keys probe via the range-
     # partitioned lax.all_to_all path (parallel/pjoin.py) instead of
     # replicating onto every shard; below it, broadcast wins.  ClassVar:
     # NOT a dataclass field, so tests/operators can override on the class.
-    PARTITION_MIN_KEYS: ClassVar[int] = int(
-        os.environ.get("CSVPLUS_PARTITION_MIN_KEYS", 4_000_000)
-    )
+    PARTITION_MIN_KEYS: ClassVar[int] = env_int("CSVPLUS_PARTITION_MIN_KEYS", 4_000_000)
 
     # Point lookups (find/sub_index/has) mirror the sorted key array to
     # host once, up to this many keys (64MB), and binary-search there —
     # the reference's own O(log n) host search (csvplus.go:881-887) —
     # instead of paying a device round trip per lookup.
-    POINT_MIRROR_MAX_KEYS: ClassVar[int] = int(
-        os.environ.get("CSVPLUS_POINT_MIRROR_MAX_KEYS", 16_000_000)
+    POINT_MIRROR_MAX_KEYS: ClassVar[int] = env_int(
+        "CSVPLUS_POINT_MIRROR_MAX_KEYS", 16_000_000
     )
 
     @classmethod

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..columnar.table import DeviceTable, StringColumn
+from ..utils.env import env_int
 
 
 @partial(jax.jit, static_argnames=("num_keys",))
@@ -36,7 +37,7 @@ def _sort_kernel(operands: Tuple[jax.Array, ...], num_keys: int):
 # Mesh-sharded tables at or above this row count sort through the
 # distributed sample-sort (parallel/dsort.py) instead of the replicated
 # lax.sort, which lands the whole array on every chip.
-DSORT_MIN_ROWS = int(os.environ.get("CSVPLUS_DSORT_MIN_ROWS", 1_000_000))
+DSORT_MIN_ROWS = env_int("CSVPLUS_DSORT_MIN_ROWS", 1_000_000)
 
 
 def _sharded_mesh(key_cols) -> "Optional[object]":

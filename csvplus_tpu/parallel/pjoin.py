@@ -73,6 +73,7 @@ except ImportError:  # pragma: no cover - older jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..obs.recompile import register_kernel
+from ..utils.env import env_int, env_str
 from .mesh import row_spec, shard_rows
 
 _SENTINEL = np.int32(np.iinfo(np.int32).max)
@@ -567,7 +568,7 @@ def skew_enabled() -> bool:
     capacity — the skew-naive baseline the bench gate compares
     against.  Read per call so one process can flip it between
     passes (the bench measures both modes in the same run)."""
-    return os.environ.get("CSVPLUS_JOIN_SKEW", "1") != "0"
+    return env_str("CSVPLUS_JOIN_SKEW", "1") != "0"
 
 
 def skew_threshold(n_shards: int) -> float:
@@ -582,7 +583,7 @@ def skew_threshold(n_shards: int) -> float:
     shard pays the doubled exchange.  Broadcasting such a key instead
     costs one replicated answer slot — O(1) — so the cutoff sits where
     the repartition cost first becomes super-linear."""
-    v = os.environ.get("CSVPLUS_JOIN_SKEW_THRESHOLD")
+    v = env_str("CSVPLUS_JOIN_SKEW_THRESHOLD")
     if v:
         return max(float(v), 1e-6)
     return 1.0 / (2.0 * max(int(n_shards), 1))
@@ -593,7 +594,7 @@ def _skew_sample_cap() -> int:
     the bound the sync-accounting tests pin).  Detection resolves key
     shares down to ~16/cap, so benches raise it to see deeper into the
     Zipf tail."""
-    return max(int(os.environ.get("CSVPLUS_JOIN_SKEW_SAMPLE", 4096)), 64)
+    return max(env_int("CSVPLUS_JOIN_SKEW_SAMPLE", 4096), 64)
 
 
 def _detect_hot(qk_dev, n_shards: int, wide: bool):
@@ -771,7 +772,7 @@ def _hot_answers_device(mesh, hot: np.ndarray, prepared, wide: bool):
         ans_ct = jnp.concatenate([ans_ct, jnp.zeros(n_hot - hot.size, jnp.int32)])
         ans_lo = jax.device_put(ans_lo, repl)
         ans_ct = jax.device_put(ans_ct, repl)
-    return vals, ans_lo, ans_ct, n_hot
+    return vals, ans_lo, ans_ct
 
 
 def _retry_probe_device(mesh: Mesh, m: int, capacity: "int | None", launch):
@@ -862,7 +863,11 @@ def partitioned_probe_device(
         from ..utils.observe import telemetry
 
         with telemetry.stage("join:broadcast", int(hot.size)) as _b:
-            (hot_vals,), hot_lo, hot_ct, n_hot = _hot_answers_device(
+            # the static lane width is the pow2 bucket of the hot COUNT
+            # (shape-derived, log-bounded distinct values), never the
+            # hot values themselves
+            n_hot = _pow2(hot.size)
+            (hot_vals,), hot_lo, hot_ct = _hot_answers_device(
                 mesh, hot, prepared, wide=False
             )
             _b["n_hot"] = n_hot
@@ -910,7 +915,8 @@ def partitioned_probe_device_wide(
         from ..utils.observe import telemetry
 
         with telemetry.stage("join:broadcast", int(hot.size)) as _b:
-            (hot_hi, hot_lo_lane), hot_ans_lo, hot_ans_ct, n_hot = (
+            n_hot = _pow2(hot.size)  # pow2 bucket: log-bounded statics
+            (hot_hi, hot_lo_lane), hot_ans_lo, hot_ans_ct = (
                 _hot_answers_device(mesh, hot, prepared, wide=True)
             )
             _b["n_hot"] = n_hot

@@ -88,6 +88,7 @@ import time
 from typing import Dict, Iterator, List, Optional, Sequence, Union
 
 from ..errors import CsvPlusError
+from ..utils.env import env_str
 
 __all__ = [
     "SITES",
@@ -330,7 +331,7 @@ def active(plan: FaultPlan) -> Iterator[FaultPlan]:
 def plan_from_env(env=None) -> Optional[FaultPlan]:
     """Parse ``CSVPLUS_FAULTS`` (JSON: either a list of spec dicts or
     ``{"seed": N, "faults": [...]}``) into a plan, or None when unset."""
-    raw = (os.environ if env is None else env).get("CSVPLUS_FAULTS")
+    raw = env_str("CSVPLUS_FAULTS", env=env)
     if not raw:
         return None
     obj = json.loads(raw)

@@ -50,6 +50,7 @@ import numpy as np
 
 from ..index import Index, IndexImpl
 from ..row import Row
+from ..utils.env import env_float
 from ..utils.observe import telemetry
 from .lsm import DeltaTier, MutableIndex, TierSet, _upsert_filter, tier_rows
 
@@ -463,12 +464,7 @@ class Compactor:
         self.policy = policy
         self.ratio = ratio
         if readamp_target is None:
-            try:
-                readamp_target = float(
-                    os.environ.get("CSVPLUS_LSM_READAMP_TARGET", "")
-                )
-            except ValueError:
-                readamp_target = 4.0
+            readamp_target = env_float("CSVPLUS_LSM_READAMP_TARGET", 4.0)
         self.readamp_target = float(readamp_target)
         if self.readamp_target < 1.0:
             raise ValueError("readamp_target must be >= 1.0")

@@ -52,7 +52,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..utils.env import env_int
+from ..utils.env import env_int, env_str
 
 __all__ = [
     "DEFAULT_BITS_PER_KEY",
@@ -84,7 +84,7 @@ def prune_enabled() -> bool:
     """``CSVPLUS_LSM_PRUNE`` — default on; ``0``/``off``/``false`` kills
     fence+filter pruning entirely (the bitwise-parity escape hatch the
     property tests diff against)."""
-    return os.environ.get("CSVPLUS_LSM_PRUNE", "1").lower() not in (
+    return (env_str("CSVPLUS_LSM_PRUNE", "1") or "1").lower() not in (
         "0",
         "off",
         "false",

@@ -56,6 +56,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..errors import CsvPlusError
 from ..obs import flight as _flight
+from ..utils.env import env_int, env_str
 from ..resilience import faults
 
 __all__ = ["Wal", "WalError", "wal_sync_mode"]
@@ -80,7 +81,7 @@ def wal_sync_mode(explicit: Optional[str] = None) -> str:
     ``CSVPLUS_WAL_SYNC`` environment knob beats the ``always`` default.
     Unknown values raise (a typo'd durability knob must not silently
     weaken the ack contract the way a typo'd tuning knob may degrade)."""
-    mode = explicit if explicit is not None else os.environ.get(
+    mode = explicit if explicit is not None else env_str(
         "CSVPLUS_WAL_SYNC", "always"
     )
     if mode not in _SYNC_MODES:
@@ -185,12 +186,7 @@ class Wal:
         self.sync = wal_sync_mode(sync)
         self._columns = list(columns or [])
         if segment_bytes is None:
-            try:
-                segment_bytes = int(
-                    os.environ.get("CSVPLUS_WAL_SEGMENT_BYTES", 8 << 20)
-                )
-            except ValueError:
-                segment_bytes = 8 << 20
+            segment_bytes = env_int("CSVPLUS_WAL_SEGMENT_BYTES", 8 << 20)
         self._segment_bytes = int(segment_bytes)
         # reentrant: the public entries hold it across the internal
         # roll/open/drop helpers, which retake it for their own
